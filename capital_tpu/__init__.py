@@ -1,0 +1,34 @@
+"""capital_tpu — a TPU-native communication-avoiding dense linear algebra framework.
+
+A ground-up JAX / XLA / Pallas re-design of the capabilities of the reference
+CAPITAL library (communication-avoiding parallel schedules for dense matrix
+factorizations): 3D SUMMA matrix multiplication, communication-optimal recursive
+Cholesky factorization with simultaneous triangular inverse, communication-
+avoiding CholeskyQR2 for tall-skinny matrices, distributed triangular inversion,
+Newton-Schulz iterative inversion, and the surrounding validation / benchmark /
+autotune harness.
+
+Where the reference expresses parallelism through MPI communicator splits over a
+d x d x c process grid (reference: src/util/topology.h) and delegates local
+compute to MKL BLAS/LAPACK (reference: src/blas/interface.hpp,
+src/lapack/interface.hpp), this framework expresses the same schedules on a TPU
+device mesh: axis-scoped collectives (psum, all_gather, ppermute) inside
+shard_map over ICI/DCN, dense masked tiles instead of packed triangular
+storage, lax.linalg plus Pallas kernels for panel factorizations, and
+trace-time block scheduling in place of runtime recursion.
+
+Package layout:
+  parallel/  - device-mesh topology, collectives, SUMMA (reference L2 + L4 matmult)
+  ops/       - local compute engines: BLAS/LAPACK equivalents, masks, Pallas kernels
+               (reference L3' src/blas + src/lapack)
+  models/    - the algorithm families: cholesky (cholinv), qr (cacqr),
+               inverse (rectri/newton), trsm (reference L4 src/alg)
+  utils/     - deterministic fillers, residual validation, tracing, config
+               (reference src/util + test/ + critter shims)
+  bench/     - benchmark drivers (reference bench/)
+  autotune/  - config sweep harness (reference autotune/)
+"""
+
+__version__ = "0.1.0"
+
+from capital_tpu.parallel.topology import Grid  # noqa: F401

@@ -1,0 +1,1 @@
+from capital_tpu.parallel.topology import Grid, cpu_grid_square  # noqa: F401

@@ -1,0 +1,205 @@
+"""Device-mesh topology: the TPU-native equivalent of CAPITAL's process grids.
+
+The reference (src/util/topology.h) builds 3D process grids by splitting MPI
+communicators: ``topo::square`` is a d x d x c grid (face d x d, replication
+depth c) with named sub-communicators {world, row, column, slice, depth};
+``topo::rect`` is a tunable c x d grid for tall-skinny QR with extra
+{cube, column_contig, column_alt} sub-communicators (topology.h:16-143).
+
+On TPU the whole layer collapses to a `jax.sharding.Mesh` with named axes
+``('x', 'y', 'z')`` plus sharding helpers:
+
+  - sub-communicator  ->  mesh axis name used by an axis-scoped collective
+        row    comm (vary x, fixed y,z)  ->  collectives over axis 'x'
+        column comm (vary y, fixed x,z)  ->  collectives over axis 'y'
+        depth  comm (vary z)             ->  collectives over axis 'z'
+        slice  comm (vary x,y)           ->  collectives over ('x', 'y')
+        world                            ->  collectives over ('x', 'y', 'z')
+  - grid coordinates (x,y,z)  ->  `jax.lax.axis_index` inside shard_map
+  - communicator free/destructor -> nothing (meshes are cheap values)
+
+Matrix distribution convention (used throughout the framework): a global
+(M, N) array is **block**-distributed with rows split over mesh axis 'x' and
+columns over mesh axis 'y', replicated over 'z' — i.e.
+``NamedSharding(mesh, P('x', 'y'))``.  Note this deliberately differs from the
+reference, which distributes *element-cyclically* over the PgridX x PgridY
+face (structure.hpp strides global positions by the grid dims per local
+element; matrix.hpp:6-18): cyclic layout exists there to load-balance
+triangular work, which this framework instead handles with block-level
+masking/predication, while contiguous blocks are what XLA/MXU tiling wants.
+Matrix *content* stays comparable across the two layouts because fillers are
+seeded from global coordinates (see utils/rand48.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = ("x", "y", "z")
+
+
+def _infer_square_face(num_devices: int, c: int) -> int:
+    """d = sqrt(P / c), the face dimension of a d x d x c grid.
+
+    Mirrors topo::square's ``d = ceil(sqrt(size/c))`` (topology.h:76-78), but
+    requires exact divisibility: TPU meshes cannot leave devices idle.
+    """
+    if num_devices % c != 0:
+        raise ValueError(f"num_devices={num_devices} not divisible by c={c}")
+    face = num_devices // c
+    d = int(round(math.sqrt(face)))
+    if d * d != face:
+        raise ValueError(
+            f"num_devices/c = {face} is not a perfect square; "
+            f"cannot build a d x d x {c} grid from {num_devices} devices"
+        )
+    return d
+
+
+@dataclasses.dataclass(frozen=True)
+class Grid:
+    """A d x d x c (or dx x dy x c) device grid backed by a jax Mesh.
+
+    TPU-native stand-in for ``topo::square`` / ``topo::rect``
+    (reference src/util/topology.h:16-143).
+
+    Attributes:
+      mesh: Mesh with axes ('x', 'y', 'z') of shape (dx, dy, c).
+      c:    replication depth (the 'z' axis extent) — trades memory for
+            communication exactly like the reference's rep_factor.
+    """
+
+    mesh: Mesh
+
+    # ---- constructors ------------------------------------------------------
+
+    @staticmethod
+    def square(c: int = 1, devices: Optional[Sequence[jax.Device]] = None) -> "Grid":
+        """Build a d x d x c grid from all (or the given) devices.
+
+        Reference: topo::square ctor, topology.h:67-131.  The reference's
+        three rank->coordinate ``layout`` variants (incl. the 64-rank subcube
+        blocking, topology.h:104-123) are physical-placement tuning knobs; on
+        TPU the analogous knob is device order in the mesh, which XLA already
+        lays out for ICI locality, so layout is not exposed here.
+        """
+        devices = list(devices if devices is not None else jax.devices())
+        d = _infer_square_face(len(devices), c)
+        dev = np.asarray(devices).reshape(d, d, c)
+        return Grid(mesh=Mesh(dev, AXES))
+
+    @staticmethod
+    def rect(
+        dx: int,
+        dy: int,
+        c: int = 1,
+        devices: Optional[Sequence[jax.Device]] = None,
+    ) -> "Grid":
+        """Build a dx x dy x c grid (tunable shape, reference topo::rect).
+
+        Reference: topology.h:16-65.  The reference's rect grid carries extra
+        sub-communicators (cube, column_contig, column_alt) used by
+        cacqr's tunable sweep; here those become axis subsets at collective
+        call sites (see models/qr.py).
+        """
+        devices = list(devices if devices is not None else jax.devices())
+        if dx * dy * c != len(devices):
+            raise ValueError(f"{dx}*{dy}*{c} != {len(devices)} devices")
+        dev = np.asarray(devices).reshape(dx, dy, c)
+        return Grid(mesh=Mesh(dev, AXES))
+
+    @staticmethod
+    def flat(devices: Optional[Sequence[jax.Device]] = None) -> "Grid":
+        """A P x 1 x 1 grid: every device along 'x'.
+
+        Used for the 1D tall-skinny regime (cacqr's c==1 path,
+        reference cacqr.hpp:7-29) where the long axis is sharded over all
+        devices and everything else is replicated.
+        """
+        devices = list(devices if devices is not None else jax.devices())
+        dev = np.asarray(devices).reshape(len(devices), 1, 1)
+        return Grid(mesh=Mesh(dev, AXES))
+
+    # ---- geometry ----------------------------------------------------------
+
+    @property
+    def dx(self) -> int:
+        return self.mesh.shape["x"]
+
+    @property
+    def dy(self) -> int:
+        return self.mesh.shape["y"]
+
+    @property
+    def c(self) -> int:
+        return self.mesh.shape["z"]
+
+    @property
+    def num_devices(self) -> int:
+        return self.dx * self.dy * self.c
+
+    @property
+    def is_square(self) -> bool:
+        return self.dx == self.dy
+
+    # ---- sharding helpers --------------------------------------------------
+
+    def face_sharding(self) -> NamedSharding:
+        """Block distribution over the grid face, replicated over depth.
+
+        The standard layout for every distributed matrix in the framework:
+        rows over 'x', columns over 'y' (reference matrix.hpp:6-18).
+        """
+        return NamedSharding(self.mesh, P("x", "y"))
+
+    def replicated_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def rows_sharding(self) -> NamedSharding:
+        """Long-axis distribution: rows over all three axes, cols replicated.
+
+        The tall-skinny layout (reference: Q registered on the full c x d
+        rect grid, cacqr.hpp:224)."""
+        return NamedSharding(self.mesh, P(("x", "y", "z"), None))
+
+    def spec(self, *spec) -> NamedSharding:
+        return NamedSharding(self.mesh, P(*spec))
+
+    # ---- shape utilities ---------------------------------------------------
+
+    def face_tile(self, m: int, n: int) -> tuple[int, int]:
+        """Padded global shape so (rows, cols) divide evenly over (dx, dy).
+
+        The reference pads implicitly with zero rows/cols per-rank
+        (structure.hpp:42-43, matrix.hpp:7-11); here padding happens once,
+        globally, at the boundary (SURVEY §7.1 'pad-to-tile')."""
+        pm = -(-m // self.dx) * self.dx
+        pn = -(-n // self.dy) * self.dy
+        return pm, pn
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"Grid({self.dx}x{self.dy}x{self.c}, {self.mesh.devices.ravel()[0].platform})"
+
+
+def cpu_grid_square(c: int = 1, n: Optional[int] = None) -> Grid:
+    """Square grid over host-platform (CPU) devices — the multi-chip test rig.
+
+    The reference tests distributed behavior by oversubscribed ``mpirun -n 8``
+    (SURVEY §4); the equivalent here is N virtual CPU devices via
+    ``--xla_force_host_platform_device_count`` (see tests/conftest.py).
+    """
+    devices = jax.devices("cpu")
+    if n is not None:
+        if n > len(devices):
+            raise ValueError(
+                f"requested {n} CPU devices but only {len(devices)} exist "
+                "(raise --xla_force_host_platform_device_count)"
+            )
+        devices = devices[:n]
+    return Grid.square(c=c, devices=devices)

@@ -1,0 +1,1 @@
+from capital_tpu.utils import rand48, residual  # noqa: F401

@@ -1,0 +1,106 @@
+"""Deterministic, grid-independent matrix fillers (drand48-compatible).
+
+The reference fills distributed matrices with per-element values derived from
+the POSIX rand48 generator so that the *global* matrix content is independent
+of the process-grid shape: the symmetric filler re-seeds ``srand48`` from the
+global element coordinates for every element and takes one ``drand48`` draw
+(reference src/matrix/structure.hpp:68-105).  That coordinate-seeded scheme is
+what makes cross-grid and cross-implementation validation possible, so this
+module reproduces it bit-for-bit — vectorized over the whole array instead of
+an element loop.
+
+rand48 recurrence (POSIX): X_{n+1} = (a * X_n + c) mod 2^48 with
+a = 0x5DEECE66D, c = 0xB; ``srand48(s)`` sets X = (s << 16) | 0x330E;
+``drand48()`` advances once and returns X / 2^48.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_A = np.uint64(0x5DEECE66D)
+_C = np.uint64(0xB)
+_MASK48 = np.uint64((1 << 48) - 1)
+_SRAND_LOW = np.uint64(0x330E)
+_TWO48 = float(1 << 48)
+
+
+def drand48_from_seed(seeds: np.ndarray) -> np.ndarray:
+    """First drand48() draw after srand48(seed), elementwise over `seeds`.
+
+    Equivalent to the reference's per-element ``srand48(seed); drand48()``
+    (structure.hpp:80-85) but vectorized.
+    """
+    seeds = np.asarray(seeds)
+    x = ((seeds.astype(np.uint64) << np.uint64(16)) | _SRAND_LOW) & _MASK48
+    x = (_A * x + _C) & _MASK48
+    return x.astype(np.float64) / _TWO48
+
+
+def symmetric(
+    n: int,
+    diagonally_dominant: bool = True,
+    dtype=np.float64,
+    rows: slice | None = None,
+    cols: slice | None = None,
+) -> np.ndarray:
+    """Dense SPD-ready symmetric matrix, identical to the reference's
+    ``distribute_symmetric`` global content (structure.hpp:68-105).
+
+    Element (r, c) is seeded with ``max(r,c) + n*min(r,c)`` (symmetric in
+    r,c — the reference computes ``gx>gy ? gx + N*gy : gy + N*gx`` with
+    gx=column, gy=row); the diagonal gains +n when `diagonally_dominant`,
+    making the matrix SPD.
+
+    `rows`/`cols` optionally restrict generation to a sub-block (so each
+    device can generate only its shard).
+    """
+    r = np.arange(n, dtype=np.uint64)[rows if rows is not None else slice(None)]
+    c = np.arange(n, dtype=np.uint64)[cols if cols is not None else slice(None)]
+    R = r[:, None]
+    C = c[None, :]
+    lo = np.minimum(R, C)
+    hi = np.maximum(R, C)
+    seeds = hi + np.uint64(n) * lo
+    out = drand48_from_seed(seeds)
+    if diagonally_dominant:
+        out = out + np.where(R == C, float(n), 0.0)
+    return out.astype(dtype)
+
+
+def random(
+    m: int,
+    n: int,
+    key: int = 0,
+    dtype=np.float64,
+    rows: slice | None = None,
+    cols: slice | None = None,
+) -> np.ndarray:
+    """Dense random matrix in [0,1), grid-independent.
+
+    The reference's ``distribute_random`` (structure.hpp:106-130) seeds once
+    and draws in local element order, which makes the global content depend on
+    the grid shape — a latent bug for cross-grid validation.  Here every
+    element is coordinate-seeded (``key*M*N + r*N + c``) like the symmetric
+    filler, so the global matrix is grid-independent by construction
+    (improvement noted in SURVEY §4).
+    """
+    r = np.arange(m, dtype=np.uint64)[rows if rows is not None else slice(None)]
+    c = np.arange(n, dtype=np.uint64)[cols if cols is not None else slice(None)]
+    seeds = (
+        np.uint64(key) * np.uint64(m) * np.uint64(n)
+        + r[:, None] * np.uint64(n)
+        + c[None, :]
+    )
+    return drand48_from_seed(seeds).astype(dtype)
+
+
+def identity(m: int, n: int, dtype=np.float64) -> np.ndarray:
+    """Reference ``distribute_identity`` equivalent (matrix.h:67)."""
+    return np.eye(m, n, dtype=dtype)
+
+
+def debug(m: int, n: int, dtype=np.float64) -> np.ndarray:
+    """Reference ``distribute_debug`` equivalent: element = global flat index,
+    useful for asserting layouts (matrix.h:68)."""
+    return (np.arange(m * n, dtype=np.float64).reshape(m, n)).astype(dtype)

@@ -1,0 +1,1 @@
+from capital_tpu.ops import masking  # noqa: F401

@@ -1,0 +1,50 @@
+"""Triangular masks — the TPU replacement for packed triangular storage.
+
+The reference stores triangular matrices packed (uppertri/lowertri structure
+policies, src/matrix/structure.h:37-72) to save memory and uses trmm/syrk to
+save flops.  On TPU, packed storage defeats MXU tiling; the idiomatic design
+(SURVEY §7.1) is dense storage + masks: masking is elementwise, fuses into the
+surrounding matmul, and costs no extra HBM traffic.  These helpers are the
+whole of what remains of the reference's structure-policy axis.
+
+All functions are shard-transparent: on a P('x','y')-sharded global array the
+mask computation is purely local to each shard (XLA partitions the iota).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def triu_mask(n: int, dtype=bool) -> jnp.ndarray:
+    r = jnp.arange(n)
+    return (r[:, None] <= r[None, :]).astype(dtype)
+
+
+def tril_mask(n: int, dtype=bool) -> jnp.ndarray:
+    r = jnp.arange(n)
+    return (r[:, None] >= r[None, :]).astype(dtype)
+
+
+def take_triangle(A: jnp.ndarray, uplo: str) -> jnp.ndarray:
+    """Zero the dead half — reference util::remove_triangle (util.hpp:266-293),
+    which zeroes the half *not* kept; here `uplo` names the half to keep."""
+    if uplo == "U":
+        return jnp.triu(A)
+    if uplo == "L":
+        return jnp.tril(A)
+    raise ValueError(f"uplo must be 'U' or 'L', got {uplo!r}")
+
+
+def with_unit_diagonal(A: jnp.ndarray) -> jnp.ndarray:
+    """Force ones on the diagonal (trmm/trsm 'Diag::AblasUnit' support,
+    reference blas::Diag, engine.h:23-52)."""
+    eye = jnp.eye(A.shape[-2], A.shape[-1], dtype=A.dtype)
+    return A * (1 - eye) + eye
+
+
+def symmetrize_from(A: jnp.ndarray, uplo: str) -> jnp.ndarray:
+    """Fill the dead half from the stored half: A_sym = tri + triᵀ − diag."""
+    T = take_triangle(A, uplo)
+    d = jnp.diagonal(T)
+    return T + T.T - jnp.diag(d)

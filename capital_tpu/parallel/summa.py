@@ -1,0 +1,239 @@
+"""SUMMA: distributed matrix multiplication on the device grid.
+
+TPU-native re-design of the reference's 3D SUMMA (src/alg/matmult/summa/
+summa.hpp).  The reference implements C = alpha*op(A)op(B) + beta*C on a
+d x d x c process grid by broadcasting A-panels along the row communicator and
+B-panels along the column communicator from depth-dependent roots, running a
+local MKL gemm, and allreducing partial C over the depth communicator
+(summa.hpp:177-249), with an optional chunked Ibcast/Iallreduce pipeline
+(num_chunks, summa.hpp:196-215).  Overloads cover gemm, in-place triangular
+trmm, and syrk-via-transpose (summa.hpp:7-161).
+
+Here the same capability is expressed two ways, selectable per call:
+
+* ``mode='xla'`` (default): the contraction is written as a plain jnp matmul
+  with sharding constraints pinning operands and result to the grid face; the
+  XLA SPMD partitioner plans the panel gathers and the depth psum itself.
+  This is the idiomatic TPU path — GSPMD already implements SUMMA-family
+  schedules, and the latency-hiding scheduler overlaps the collectives the
+  way the reference's chunked pipeline does by hand.
+
+* ``mode='explicit'``: a shard_map kernel that owns the schedule exactly like
+  the reference owns its MPI calls: a step loop over K-panel broadcasts
+  (masked-psum bcast from the owning row/column — the collective analog of
+  MPI_Bcast from a root), local dot_general per step, K-steps partitioned
+  over the depth axis 'z' (the 2.5D flop split), and a final psum over 'z'
+  (the reference's MPI_Allreduce collect, summa.hpp:236).  This path is the
+  control knob for communication research and is benchmarked against 'xla'.
+
+Triangular structure (trmm) and symmetric rank-k updates (syrk) are expressed
+as masked gemms: dense tiles + elementwise masks fuse into the matmul and keep
+the MXU full, replacing the reference's packed-storage policies (SURVEY §7.1).
+
+All functions take and return **global** jax Arrays (any sharding; they pin
+layouts internally) and are jit-compatible.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from capital_tpu.ops import masking
+from capital_tpu.parallel.topology import Grid
+
+
+@dataclasses.dataclass(frozen=True)
+class GemmArgs:
+    """Mirror of blas::ArgPack_gemm (reference src/blas/engine.h:72-94)."""
+
+    alpha: float = 1.0
+    beta: float = 0.0
+    trans_a: bool = False
+    trans_b: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class TrmmArgs:
+    """Mirror of blas::ArgPack_trmm (reference src/blas/engine.h:96-112)."""
+
+    side: str = "L"  # 'L': B <- alpha*op(A)B ; 'R': B <- alpha*B*op(A)
+    uplo: str = "U"
+    trans_a: bool = False
+    diag: str = "N"  # 'N' non-unit, 'U' unit diagonal
+    alpha: float = 1.0
+
+
+@dataclasses.dataclass(frozen=True)
+class SyrkArgs:
+    """Mirror of blas::ArgPack_syrk (reference src/blas/engine.h:114-130)."""
+
+    uplo: str = "U"
+    trans: bool = False  # False: C = a*A*Aᵀ + b*C ; True: C = a*AᵀA + b*C
+    alpha: float = 1.0
+    beta: float = 0.0
+
+
+def _pin(grid: Grid, x: jnp.ndarray) -> jnp.ndarray:
+    """Constrain to the face layout (rows over 'x', cols over 'y')."""
+    return lax.with_sharding_constraint(x, grid.face_sharding())
+
+
+# --------------------------------------------------------------------------
+# explicit shard_map schedule
+# --------------------------------------------------------------------------
+
+
+def _explicit_matmul(grid: Grid, A: jnp.ndarray, B: jnp.ndarray) -> jnp.ndarray:
+    """C = A @ B with the explicit SUMMA step schedule on the d x d x c grid.
+
+    Schedule (mirrors summa.hpp:177-249, re-expressed with axis collectives):
+      for step k in this layer's share of the d K-panels:
+        a_panel = bcast(A[:, k-panel] from grid column y==k)   # row comm bcast
+        b_panel = bcast(B[k-panel, :] from grid row x==k)      # column comm bcast
+        acc += a_panel @ b_panel                               # local gemm
+      C = psum(acc, 'z')                                       # depth collect
+
+    Bcast-from-root is realized as psum of a root-masked operand — the
+    standard axis-collective encoding of MPI_Bcast.  K-steps are split
+    contiguously over the depth axis: layer z handles steps
+    [z*d/c, (z+1)*d/c), which is the 2.5D replication trade (topology.h:76-78
+    replication depth c).
+    """
+    d, c = grid.dx, grid.c
+    if grid.dy != d:
+        raise ValueError("explicit SUMMA requires a square grid face")
+    if d % c != 0:
+        raise ValueError(f"depth c={c} must divide face d={d}")
+    (M, K), (K2, N) = A.shape, B.shape
+    if K != K2:
+        raise ValueError(f"inner dims mismatch: {A.shape} @ {B.shape}")
+    if M % d or K % d or N % d:
+        raise ValueError(f"global dims {(M, K, N)} must be divisible by d={d}")
+
+    steps_per_layer = d // c
+
+    def kernel(a, b):
+        # a: (M/d, K/d) block at (x, y);  b: (K/d, N/d) block at (x, y)
+        xi = lax.axis_index("x")
+        yi = lax.axis_index("y")
+        zi = lax.axis_index("z")
+
+        def body(i, acc):
+            k = zi * steps_per_layer + i
+            a_panel = lax.psum(jnp.where(yi == k, a, jnp.zeros_like(a)), "y")
+            b_panel = lax.psum(jnp.where(xi == k, b, jnp.zeros_like(b)), "x")
+            return acc + a_panel @ b_panel
+
+        acc = jnp.zeros((a.shape[0], b.shape[1]), dtype=jnp.result_type(a, b))
+        acc = lax.pcast(acc, ("x", "y", "z"), to="varying")  # device-varying carry
+        acc = lax.fori_loop(0, steps_per_layer, body, acc, unroll=True)
+        return lax.psum(acc, "z")
+
+    return jax.shard_map(
+        kernel,
+        mesh=grid.mesh,
+        in_specs=(P("x", "y"), P("x", "y")),
+        out_specs=P("x", "y"),
+    )(_pin(grid, A), _pin(grid, B))
+
+
+# --------------------------------------------------------------------------
+# public ops
+# --------------------------------------------------------------------------
+
+
+def _matmul(grid: Grid, A: jnp.ndarray, B: jnp.ndarray, mode: str) -> jnp.ndarray:
+    if mode == "xla":
+        return _pin(grid, _pin(grid, A) @ _pin(grid, B))
+    if mode == "explicit":
+        return _explicit_matmul(grid, A, B)
+    raise ValueError(f"unknown summa mode {mode!r}")
+
+
+def gemm(
+    grid: Grid,
+    A: jnp.ndarray,
+    B: jnp.ndarray,
+    C: jnp.ndarray | None = None,
+    args: GemmArgs = GemmArgs(),
+    mode: str = "xla",
+) -> jnp.ndarray:
+    """C = alpha * op(A) @ op(B) + beta * C  (reference summa.hpp:7-44)."""
+    Aop = A.T if args.trans_a else A
+    Bop = B.T if args.trans_b else B
+    if args.beta != 0.0 and C is None:
+        raise ValueError("beta != 0 requires the accumulate operand C")
+    out = _matmul(grid, Aop, Bop, mode)
+    if args.alpha != 1.0:
+        out = args.alpha * out
+    if args.beta != 0.0:
+        out = out + args.beta * _pin(grid, C)
+    return _pin(grid, out)
+
+
+def trmm(
+    grid: Grid,
+    A: jnp.ndarray,
+    B: jnp.ndarray,
+    args: TrmmArgs = TrmmArgs(),
+    mode: str = "xla",
+) -> jnp.ndarray:
+    """B <- alpha * op(tri(A)) @ B   (side L)   or   alpha * B @ op(tri(A))
+    (side R) — reference summa.hpp:47-83.
+
+    The triangular operand is dense + masked; the mask fuses into the matmul
+    (no packed storage — SURVEY §7.1)."""
+    T = masking.take_triangle(A, args.uplo)
+    if args.diag == "U":
+        T = masking.with_unit_diagonal(T)
+    Top = T.T if args.trans_a else T
+    if args.side == "L":
+        out = _matmul(grid, Top, B, mode)
+    elif args.side == "R":
+        out = _matmul(grid, B, Top, mode)
+    else:
+        raise ValueError(f"side must be 'L' or 'R', got {args.side!r}")
+    if args.alpha != 1.0:
+        out = args.alpha * out
+    return _pin(grid, out)
+
+
+def syrk(
+    grid: Grid,
+    A: jnp.ndarray,
+    C: jnp.ndarray | None = None,
+    args: SyrkArgs = SyrkArgs(),
+    mode: str = "xla",
+) -> jnp.ndarray:
+    """Symmetric rank-k update (reference summa.hpp:86-161, which lowers syrk
+    to an explicit grid transpose + gemm; here the transpose is a logical
+    .T — XLA emits the collective-permute when resharding is needed).
+
+    trans=False: C = alpha*A@Aᵀ + beta*C;  trans=True: C = alpha*Aᵀ@A + beta*C.
+    The full dense symmetric result is computed (MXU-friendly); callers that
+    need only a triangle mask the output.
+    """
+    if args.beta != 0.0 and C is None:
+        raise ValueError("beta != 0 requires the accumulate operand C")
+    Aop = (A.T, A) if args.trans else (A, A.T)
+    out = _matmul(grid, Aop[0], Aop[1], mode)
+    if args.alpha != 1.0:
+        out = args.alpha * out
+    if args.beta != 0.0:
+        out = out + args.beta * _pin(grid, C)
+    return _pin(grid, out)
+
+
+def transpose(grid: Grid, A: jnp.ndarray) -> jnp.ndarray:
+    """Grid transpose: Aᵀ re-pinned to the face layout.
+
+    Reference util::transpose swaps blocks with the mirrored grid rank via
+    MPI_Sendrecv_replace (util.hpp:232-247); on TPU the same data motion is
+    XLA's collective-permute, emitted from the layout constraint."""
+    return _pin(grid, A.T)

@@ -1,0 +1,100 @@
+"""M1 tests: SUMMA gemm/trmm/syrk vs numpy, both execution modes, all grids."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from capital_tpu.parallel import summa
+from capital_tpu.parallel.summa import GemmArgs, SyrkArgs, TrmmArgs
+from capital_tpu.utils import rand48
+
+MODES = ["xla", "explicit"]
+
+
+def _put(grid, x):
+    return jax.device_put(jnp.asarray(x), grid.face_sharding())
+
+
+@pytest.fixture(params=["grid2x2x1", "grid2x2x2"])
+def grid(request):
+    return request.getfixturevalue(request.param)
+
+
+class TestGemm:
+    @pytest.mark.parametrize("mode", MODES)
+    def test_plain(self, grid, mode):
+        A = rand48.random(32, 48, key=1)
+        B = rand48.random(48, 24, key=2)
+        C = summa.gemm(grid, _put(grid, A), _put(grid, B), mode=mode)
+        np.testing.assert_allclose(np.asarray(C), A @ B, rtol=1e-12)
+
+    @pytest.mark.parametrize("mode", MODES)
+    def test_alpha_beta_transposes(self, grid, mode):
+        A = rand48.random(40, 32, key=3)
+        B = rand48.random(24, 40, key=4)
+        C0 = rand48.random(32, 24, key=5)
+        args = GemmArgs(alpha=2.5, beta=-0.5, trans_a=True, trans_b=True)
+        C = summa.gemm(grid, _put(grid, A), _put(grid, B), _put(grid, C0), args, mode=mode)
+        np.testing.assert_allclose(
+            np.asarray(C), 2.5 * (A.T @ B.T) - 0.5 * C0, rtol=1e-12
+        )
+
+    def test_jit_and_sharded_output(self, grid2x2x2):
+        g = grid2x2x2
+        A = _put(g, rand48.random(64, 64, key=1))
+        B = _put(g, rand48.random(64, 64, key=2))
+        f = jax.jit(lambda a, b: summa.gemm(g, a, b, mode="explicit"))
+        C = f(A, B)
+        assert C.sharding == g.face_sharding()
+        np.testing.assert_allclose(
+            np.asarray(C), np.asarray(A) @ np.asarray(B), rtol=1e-12
+        )
+
+    def test_explicit_requires_divisibility(self, grid2x2x2):
+        A = jnp.asarray(rand48.random(7, 7, key=1))
+        with pytest.raises(ValueError):
+            summa.gemm(grid2x2x2, A, A, mode="explicit")
+
+
+class TestTrmm:
+    @pytest.mark.parametrize("mode", MODES)
+    @pytest.mark.parametrize("side,uplo,trans_a,diag", [
+        ("L", "U", False, "N"),
+        ("L", "L", True, "N"),
+        ("R", "U", True, "U"),
+        ("R", "L", False, "U"),
+    ])
+    def test_variants(self, grid, mode, side, uplo, trans_a, diag):
+        n, m = 32, 32
+        A = rand48.random(n, n, key=6)
+        B = rand48.random(n, m, key=7)
+        T = np.triu(A) if uplo == "U" else np.tril(A)
+        if diag == "U":
+            np.fill_diagonal(T, 1.0)
+        Top = T.T if trans_a else T
+        want = 1.5 * (Top @ B if side == "L" else B @ Top)
+        args = TrmmArgs(side=side, uplo=uplo, trans_a=trans_a, diag=diag, alpha=1.5)
+        got = summa.trmm(grid, _put(grid, A), _put(grid, B), args, mode=mode)
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-12)
+
+
+class TestSyrk:
+    @pytest.mark.parametrize("mode", MODES)
+    @pytest.mark.parametrize("trans", [False, True])
+    def test_variants(self, grid, mode, trans):
+        A = rand48.random(32, 32, key=8)
+        C0 = rand48.symmetric(32)
+        want = 2.0 * (A.T @ A if trans else A @ A.T) + 0.5 * C0
+        args = SyrkArgs(trans=trans, alpha=2.0, beta=0.5)
+        got = summa.syrk(grid, _put(grid, A), _put(grid, C0), args, mode=mode)
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-12)
+
+
+class TestTranspose:
+    def test_transpose(self, grid2x2x2):
+        g = grid2x2x2
+        A = rand48.random(32, 16, key=9)
+        At = summa.transpose(g, _put(g, A))
+        assert At.sharding == g.face_sharding()
+        np.testing.assert_array_equal(np.asarray(At), A.T)

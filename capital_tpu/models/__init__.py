@@ -1,0 +1,1 @@
+from capital_tpu.models import cholesky  # noqa: F401

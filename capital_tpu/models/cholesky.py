@@ -1,0 +1,261 @@
+"""cholinv: communication-optimal recursive Cholesky + triangular inverse.
+
+The flagship algorithm (reference src/alg/cholesky/cholinv/), re-designed for
+TPU.  For SPD A it computes the upper-triangular factor R (A = RᵀR) and,
+simultaneously, R⁻¹ — the pair that lets CholeskyQR2 and the SPD inverse
+avoid distributed triangular solves.
+
+Reference schedule (cholinv.hpp:87-165), preserved here:
+
+    recurse(A):
+      1. R11, R11inv = recurse(A11)                       # top-left
+      2. R12 = R11⁻ᵀ · A12                                # TRSM phase (trmm)
+      3. A22' = A22 − R12ᵀ·R12                            # Schur update (syrk)
+      4. R22, R22inv = recurse(A22')
+      5. R12inv = −R11inv · R12 · R22inv                  # inverse completion
+         (skipped at the top level when complete_inv=False)
+
+TPU re-design decisions (SURVEY §7.1):
+
+* The reference's runtime window recursion over matrix views
+  (`_restrict_`/cursor arithmetic, cholinv.hpp:107-142) becomes **trace-time
+  Python recursion over static slices**: each (n, config) pair traces once
+  and compiles to a single XLA program.  The reference's two-pass
+  simulate/execute split (allocation dry-run at cholinv.hpp:22-26) maps to
+  plan (host Python, `plan()`) vs execute (the traced `factor()`).
+* Power-of-two padding (reference get_next_power2, util.hpp:249-264, and the
+  trueLocalDimension plumbing) becomes one SPD-safe global pad: embed A in
+  [[A, 0], [0, I]], factor, crop — the identity block factors to itself and
+  never pollutes the A block.
+* Base-case gather over the slice communicator + block↔cyclic repack + local
+  LAPACK (policy.h:160-224) becomes a sharding constraint (XLA emits the
+  all_gather) + lax.linalg on the replicated panel.  See
+  utils/config.py:BaseCasePolicy for how the reference's four replication
+  policies map.
+* Mixed precision: trailing updates run in the input dtype (bf16-friendly);
+  the base-case factorization runs in `base_case_dtype` (default f32 for
+  low-precision inputs) — panel factorizations are the numerically fragile
+  step, trailing matmuls are not.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from capital_tpu.ops import lapack
+from capital_tpu.parallel import summa
+from capital_tpu.parallel.summa import SyrkArgs, TrmmArgs
+from capital_tpu.parallel.topology import Grid
+from capital_tpu.utils.config import BaseCasePolicy
+
+
+@dataclasses.dataclass(frozen=True)
+class CholinvConfig:
+    """User configuration — mirrors cholesky::cholinv::info inputs
+    (reference cholinv.h:16-44).
+
+    complete_inv: compute the full R⁻¹ (True) or leave the off-diagonal
+        block of the top-level inverse zero (False) — callers like cacqr's
+        blocked solve use the diagonal inverse blocks + R12 instead
+        (cacqr.hpp:46-73).
+    split: recursion split shift — the top window is n >> split, so split=1
+        halves (reference cholinv.hpp:15-18 semantics).
+    base_case_dim: recursion bottoms out at windows <= this size.  Replaces
+        the reference's sign/multiplier encoding (bc_mult_dim) with the size
+        itself.
+    policy: base-case replication strategy (see BaseCasePolicy).
+    mode: SUMMA execution mode for the trmm/syrk phases ('xla'|'explicit').
+    base_case_dtype: dtype for the base-case potrf+trtri; None means f32
+        when the input is narrower than f32, else the input dtype.
+    """
+
+    complete_inv: bool = True
+    split: int = 1
+    base_case_dim: int = 256
+    policy: BaseCasePolicy = BaseCasePolicy.REPLICATE_COMM_COMP
+    mode: str = "xla"
+    base_case_dtype: Optional[jnp.dtype] = None
+    precision: Optional[str] = "highest"  # matmul precision for f32 inputs on
+    # TPU: 'highest' keeps the trmm/syrk phases at full f32 (the MXU default
+    # of bf16 passes costs ~3 decimal digits in the factor); set None to
+    # inherit the context default when chasing raw throughput
+
+
+# --------------------------------------------------------------------------
+# plan: the host-side schedule (reference `simulate`, cholinv.hpp:50-83)
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class PlanNode:
+    """One recursion window: [off, off+n) on the diagonal."""
+
+    off: int
+    n: int
+    is_base: bool
+    top: tuple["PlanNode", "PlanNode"] | None = None  # (A11-node, A22-node)
+
+
+def padded_dim(n: int, base_case_dim: int) -> int:
+    """Smallest base_case_dim * 2^k >= n (reference pads to a power of two,
+    util.hpp:249-264; anchoring at the base-case size keeps every window an
+    exact multiple of it)."""
+    p = min(base_case_dim, n)
+    while p < n:
+        p *= 2
+    return p
+
+
+def top_split(n: int, cfg: CholinvConfig) -> int:
+    """Column index where factor()'s top-level recursion splits the (cropped)
+    n x n output — i.e. the boundary of the zeroed off-diagonal block of Rinv
+    when complete_inv=False.  Shared by cacqr's blocked solve so the two
+    modules cannot drift apart on padding/plan details.  Returns n when the
+    whole matrix is a single base-case window (no split)."""
+    node = plan(padded_dim(n, cfg.base_case_dim), cfg)
+    return n if node.is_base else min(node.top[0].n, n)
+
+
+def plan(n: int, cfg: CholinvConfig, off: int = 0) -> PlanNode:
+    """Build the recursion schedule for a (padded) window of size n.
+
+    Pure host computation — this is the analog of the reference's simulate
+    pass: everything shape-dependent is decided here, once, before tracing.
+    """
+    if cfg.split < 1:
+        raise ValueError(f"split must be >= 1 (split={cfg.split} would not shrink the window)")
+    if n <= cfg.base_case_dim:
+        return PlanNode(off=off, n=n, is_base=True)
+    n1 = max(cfg.base_case_dim, n >> cfg.split)
+    left = plan(n1, cfg, off)
+    right = plan(n - n1, cfg, off + n1)
+    return PlanNode(off=off, n=n, is_base=False, top=(left, right))
+
+
+# --------------------------------------------------------------------------
+# execute: the traced recursion (reference `invoke`, cholinv.hpp:87-165)
+# --------------------------------------------------------------------------
+
+
+def _base_case(
+    grid: Grid, A: jnp.ndarray, cfg: CholinvConfig
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Leaf factorization: gather + local potrf/trtri (policy.h:160-224).
+
+    REPLICATE_* policies pin the panel replicated (XLA emits one all_gather
+    over the mesh; every chip factors the panel redundantly — the TPU-optimal
+    choice).  NO_REPLICATION_* leaves placement to the SPMD partitioner, the
+    analog of the reference's root-rank strategies.
+    """
+    bc_dtype = cfg.base_case_dtype
+    if bc_dtype is None:
+        bc_dtype = A.dtype if jnp.dtype(A.dtype).itemsize >= 4 else jnp.float32
+    panel = A.astype(bc_dtype)
+    if not cfg.policy.single_device_compute:
+        panel = lax.with_sharding_constraint(panel, grid.replicated_sharding())
+    R, Rinv = lapack.potrf_trtri(panel, uplo="U")
+    pin = lambda x: lax.with_sharding_constraint(
+        x.astype(A.dtype), grid.face_sharding()
+    )
+    return pin(R), pin(Rinv)
+
+
+def _recurse(
+    grid: Grid, A: jnp.ndarray, node: PlanNode, cfg: CholinvConfig, top: bool
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    if node.is_base:
+        return _base_case(grid, A, cfg)
+
+    left, right = node.top
+    n1 = left.n
+    A11 = A[:n1, :n1]
+    A12 = A[:n1, n1:]
+    A22 = A[n1:, n1:]
+
+    # 1. recurse on the top-left window (cholinv.hpp:108-111)
+    R11, R11inv = _recurse(grid, A11, left, cfg, top=False)
+
+    # 2. TRSM phase: R12 = R11⁻ᵀ · A12 (cholinv.hpp:116-123, tag CI::trsm).
+    # The reference grid-transposes R11inv then trmms; here the transpose is
+    # an argument flag and XLA plans the data motion.
+    R12 = summa.trmm(
+        grid, R11inv, A12, TrmmArgs(side="L", uplo="U", trans_a=True, precision=cfg.precision),
+        mode=cfg.mode
+    )
+
+    # 3. Schur complement: A22' = A22 − R12ᵀR12 (cholinv.hpp:131-134, CI::tmu)
+    S = summa.syrk(
+        grid, R12, A22, SyrkArgs(trans=True, alpha=-1.0, beta=1.0, precision=cfg.precision),
+        mode=cfg.mode
+    )
+
+    # 4. recurse on the trailing window (cholinv.hpp:139-142)
+    R22, R22inv = _recurse(grid, S, right, cfg, top=False)
+
+    # 5. inverse completion: R⁻¹12 = −R11inv·R12·R22inv (cholinv.hpp:147-156),
+    # skipped at the top level when complete_inv=False.
+    zeros12 = jnp.zeros_like(R12)
+    if cfg.complete_inv or not top:
+        T = summa.trmm(
+            grid, R11inv, R12,
+            TrmmArgs(side="L", uplo="U", precision=cfg.precision), mode=cfg.mode,
+        )
+        R12inv = summa.trmm(
+            grid, R22inv, T, TrmmArgs(side="R", uplo="U", alpha=-1.0, precision=cfg.precision),
+            mode=cfg.mode
+        )
+    else:
+        R12inv = zeros12
+
+    zeros21 = jnp.zeros((A.shape[0] - n1, n1), dtype=A.dtype)
+    R = jnp.block([[R11, R12], [zeros21, R22]])
+    Rinv = jnp.block([[R11inv, R12inv], [zeros21, R22inv]])
+    pin = lambda x: lax.with_sharding_constraint(x, grid.face_sharding())
+    return pin(R), pin(Rinv)
+
+
+def factor(
+    grid: Grid, A: jnp.ndarray, cfg: CholinvConfig = CholinvConfig()
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Factor SPD A into (R, Rinv): A = RᵀR, Rinv = R⁻¹ (upper triangular).
+
+    Equivalent of cholesky::cholinv::factor (cholinv.hpp:6-28); jit-friendly.
+    When complete_inv=False the returned Rinv has its top-level off-diagonal
+    block zeroed (only the two diagonal inverse blocks are valid), matching
+    the reference's contract.
+    """
+    n = A.shape[0]
+    if A.shape[0] != A.shape[1]:
+        raise ValueError(f"cholinv needs a square matrix, got {A.shape}")
+    p = padded_dim(n, cfg.base_case_dim)
+    if p != n:
+        # SPD-safe pad: diag(A, I) factors to diag(R, I) without cross-talk.
+        pad = ((0, p - n), (0, p - n))
+        Ap = jnp.pad(A, pad)
+        ii = jnp.arange(p)
+        Ap = Ap + jnp.diag((ii >= n).astype(A.dtype))
+    else:
+        Ap = A
+    Ap = lax.with_sharding_constraint(Ap, grid.face_sharding())
+    R, Rinv = _recurse(grid, Ap, plan(p, cfg), cfg, top=True)
+    if p != n:
+        R, Rinv = R[:n, :n], Rinv[:n, :n]
+    return R, Rinv
+
+
+def spd_inverse(
+    grid: Grid, A: jnp.ndarray, cfg: CholinvConfig = CholinvConfig()
+) -> jnp.ndarray:
+    """A⁻¹ = R⁻¹·R⁻ᵀ for SPD A — the 'SPD inverse via Cholesky' capability
+    (BASELINE.md config row 5)."""
+    cfg = dataclasses.replace(cfg, complete_inv=True)
+    _, Rinv = factor(grid, A, cfg)
+    return summa.gemm(
+        grid, Rinv, Rinv,
+        args=summa.GemmArgs(trans_b=True, precision=cfg.precision), mode=cfg.mode
+    )

@@ -1,0 +1,246 @@
+"""cacqr: communication-avoiding CholeskyQR2 for tall-skinny QR.
+
+TPU-native re-design of qr::cacqr (reference src/alg/qr/cacqr/), the
+CA-CQR2 algorithm (IPDPS'19, arXiv:1710.08471): for tall-skinny A (M x N,
+M >> N), one *sweep* is
+
+    G = AᵀA          (gram — the only global reduction)
+    R = chol(G)      (small N x N factorization)
+    Q = A · R⁻¹      (tall-skinny scaling)
+
+CQR2 runs two sweeps and merges R = R2·R1, recovering orthogonality to
+machine precision (cacqr.hpp:181-210).
+
+The reference dispatches on grid shape (cacqr.hpp:229-245):
+  c == 1  'invoke_1d'  : local syrk + MPI_Allreduce(world) + local LAPACK
+  c == d  'invoke_3d'  : gram via bcast/reduce pipeline + cholinv on the gram
+                          on the cube's square sub-grid + SUMMA trmm
+  1<c<d   'sweep_tune' : same with the column reduction split over
+                          column_contig/column_alt sub-communicators
+
+On a TPU mesh the three regimes collapse to one question — *where does the
+N x N gram live?* — so this module exposes two paths and an auto rule:
+
+  regime='1d'   : A is sharded along its long axis over every device
+                  (Grid.rows_sharding); the gram psum is the single
+                  collective; chol+inverse run replicated on every chip.
+                  This is the reference's 1D path and the right choice
+                  whenever N is small enough that the N x N gram fits
+                  replicated (the common tall-skinny case).
+  regime='dist' : A is face-sharded; the gram forms via distributed syrk and
+                  **cholinv.factor runs on the gram** exactly like the
+                  reference wires its 3D path into cholinv (cacqr.hpp:103);
+                  Q = A·R⁻¹ via SUMMA trmm, or the blocked triangular solve
+                  when complete_inv=False (cacqr.hpp:46-73).
+  regime='auto' : '1d' when the grid is flat or N <= dist_threshold,
+                  else 'dist'.
+
+The reference's tunable grid shape (topo::rect c,d sweep) maps to how the
+caller constructs the Grid (Grid.rect(dx, dy, c)) — mesh shape is the
+runtime knob that replaces communicator re-splitting (SURVEY §2.5).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+from jax import lax
+
+from capital_tpu.models import cholesky
+from capital_tpu.models.cholesky import CholinvConfig
+from capital_tpu.ops import lapack
+from capital_tpu.parallel import summa
+from capital_tpu.parallel.summa import GemmArgs, SyrkArgs, TrmmArgs
+from capital_tpu.parallel.topology import Grid
+
+
+@dataclasses.dataclass(frozen=True)
+class CacqrConfig:
+    """Mirror of qr::cacqr::info (reference cacqr.h:17-45).
+
+    num_iter: 1 = CholeskyQR, 2 = CholeskyQR2 (the reference's `variant`
+        driver knob, bench/qr/cacqr.cpp:14).
+    regime: '1d' | 'dist' | 'auto' (see module docstring).
+    dist_threshold: in 'auto', gram sizes above this go distributed.
+    cholinv: configuration for the nested Cholesky when regime='dist'
+        (the reference nests its cholinv pack the same way, cacqr.cpp:38-40).
+        cholinv.complete_inv=False switches Q formation to the blocked
+        triangular solve (reference cacqr.hpp:46-73).
+    """
+
+    num_iter: int = 2
+    regime: str = "auto"
+    dist_threshold: int = 4096
+    cholinv: CholinvConfig = CholinvConfig()
+    mode: str = "xla"
+    precision: str | None = "highest"  # gram/scaling matmul precision: the
+    # gram AᵀA is the numerically critical contraction of CholeskyQR — at
+    # the TPU default (bf16 passes) orthogonality degrades ~200x for f32
+    # inputs; 'highest' keeps it f32-grade
+
+
+# --------------------------------------------------------------------------
+# sweeps
+# --------------------------------------------------------------------------
+
+
+def _sweep_1d(
+    grid: Grid, A: jnp.ndarray, precision: str | None
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """One CQR sweep, 1D regime (reference sweep_1d, cacqr.hpp:7-29).
+
+    A arrives sharded along rows over the whole mesh; the gram contraction
+    AᵀA is written globally and pinned replicated — XLA emits the local
+    partial product and the all-axis psum, the exact analog of the
+    reference's local syrk + MPI_Allreduce over world (cacqr.hpp:14-25).
+    """
+    A = lax.with_sharding_constraint(A, grid.rows_sharding())
+    G = lax.with_sharding_constraint(
+        jnp.matmul(A.T, A, precision=precision), grid.replicated_sharding()
+    )
+    R, Rinv = lapack.potrf_trtri(G, uplo="U")
+    Q = lax.with_sharding_constraint(
+        jnp.matmul(A, Rinv, precision=precision), grid.rows_sharding()
+    )
+    return Q, R
+
+
+def _sweep_dist(
+    grid: Grid, A: jnp.ndarray, cfg: CacqrConfig
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """One CQR sweep, distributed regime (reference sweep_3d, cacqr.hpp:82-116).
+
+    Gram via distributed syrk, then **cholinv on the gram** (the wiring at
+    cacqr.hpp:103), then Q = A·R⁻¹ by SUMMA trmm — or, when cholinv is run
+    without the completed inverse, the 2x2 blocked solve (cacqr.hpp:46-73).
+    """
+    A = lax.with_sharding_constraint(A, grid.face_sharding())
+    G = summa.syrk(
+        grid, A, args=SyrkArgs(trans=True, precision=cfg.precision), mode=cfg.mode
+    )
+    R, Rinv = cholesky.factor(grid, G, cfg.cholinv)
+    if cfg.cholinv.complete_inv:
+        Q = summa.trmm(
+            grid, Rinv, A,
+            TrmmArgs(side="R", uplo="U", precision=cfg.precision), mode=cfg.mode,
+        )
+    else:
+        Q = solve_blocked(grid, A, R, Rinv, cfg)
+    return Q, R
+
+
+def solve_blocked(
+    grid: Grid,
+    A: jnp.ndarray,
+    R: jnp.ndarray,
+    Rinv: jnp.ndarray,
+    cfg: CacqrConfig,
+) -> jnp.ndarray:
+    """X = A·R⁻¹ from the *partial* inverse: the 2x2 blocked triangular solve
+    that is the reference's de-facto distributed TRSM (cacqr.hpp:46-73).
+
+    With R = [[R11, R12], [0, R22]] and only R11⁻¹, R22⁻¹ available (the
+    complete_inv=False contract of cholinv):
+
+        X1 = A1 · R11⁻¹
+        X2 = (A2 − X1·R12) · R22⁻¹
+    """
+    n = R.shape[0]
+    n1 = cholesky.top_split(n, cfg.cholinv)
+    if n1 == n:
+        # single base-case window: Rinv is already the full inverse
+        return summa.trmm(
+            grid, Rinv, A,
+            TrmmArgs(side="R", uplo="U", precision=cfg.precision), mode=cfg.mode,
+        )
+    A1, A2 = A[:, :n1], A[:, n1:]
+    R11inv, R22inv = Rinv[:n1, :n1], Rinv[n1:, n1:]
+    R12 = R[:n1, n1:]
+    X1 = summa.trmm(
+        grid, R11inv, A1,
+        TrmmArgs(side="R", uplo="U", precision=cfg.precision), mode=cfg.mode,
+    )
+    A2p = summa.gemm(
+        grid, X1, R12, A2,
+        GemmArgs(alpha=-1.0, beta=1.0, precision=cfg.precision), mode=cfg.mode,
+    )
+    X2 = summa.trmm(
+        grid, R22inv, A2p,
+        TrmmArgs(side="R", uplo="U", precision=cfg.precision), mode=cfg.mode,
+    )
+    return lax.with_sharding_constraint(
+        jnp.concatenate([X1, X2], axis=1), grid.face_sharding()
+    )
+
+
+# --------------------------------------------------------------------------
+# public API
+# --------------------------------------------------------------------------
+
+
+def _pick_regime(grid: Grid, n: int, cfg: CacqrConfig) -> str:
+    if cfg.regime != "auto":
+        return cfg.regime
+    if grid.dy == 1 and grid.c == 1:
+        return "1d"
+    return "1d" if n <= cfg.dist_threshold else "dist"
+
+
+def factor(
+    grid: Grid, A: jnp.ndarray, cfg: CacqrConfig = CacqrConfig()
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """QR of tall-skinny A: returns (Q, R) with A = QR, R upper triangular.
+
+    Equivalent of qr::cacqr::factor (cacqr.hpp:216-245); jit-friendly.
+    num_iter=2 (CQR2) merges the two sweeps' triangular factors with a
+    trmm, R = R2·R1 (cacqr.hpp:181-189, 204-210).
+    """
+    m, n = A.shape
+    if m < n:
+        raise ValueError(f"cacqr expects tall-skinny input, got {A.shape}")
+    if cfg.num_iter not in (1, 2):
+        raise ValueError(f"num_iter must be 1 (CQR) or 2 (CQR2), got {cfg.num_iter}")
+    regime = _pick_regime(grid, n, cfg)
+    sweep = (
+        (lambda a: _sweep_1d(grid, a, cfg.precision))
+        if regime == "1d"
+        else (lambda a: _sweep_dist(grid, a, cfg))
+    )
+    Q, R = sweep(A)
+    if cfg.num_iter == 2:
+        Q, R2 = sweep(Q)
+        # merge R = R2 · R1: both upper triangular; small local/distributed trmm
+        if regime == "1d":
+            R = jnp.matmul(jnp.triu(R2), jnp.triu(R), precision=cfg.precision)
+        else:
+            R = summa.trmm(
+                grid, R2, R,
+                TrmmArgs(side="L", uplo="U", precision=cfg.precision), mode=cfg.mode,
+            )
+    return Q, R
+
+
+def apply_Q(
+    grid: Grid,
+    Q: jnp.ndarray,
+    X: jnp.ndarray,
+    mode: str = "xla",
+    precision: str | None = "highest",
+) -> jnp.ndarray:
+    """Q @ X (reference apply_Q = SUMMA gemm, cacqr.hpp:272-280)."""
+    return summa.gemm(grid, Q, X, args=GemmArgs(precision=precision), mode=mode)
+
+
+def apply_QT(
+    grid: Grid,
+    Q: jnp.ndarray,
+    X: jnp.ndarray,
+    mode: str = "xla",
+    precision: str | None = "highest",
+) -> jnp.ndarray:
+    """Qᵀ @ X.  The reference left this as static_assert(0) (cacqr.hpp:284);
+    implemented here — it is just the transposed gemm."""
+    return summa.gemm(
+        grid, Q, X, args=GemmArgs(trans_a=True, precision=precision), mode=mode
+    )

@@ -1,0 +1,61 @@
+"""Local factorization kernels — the LAPACK seam of the framework.
+
+TPU-native equivalent of the reference's LAPACK engine
+(src/lapack/interface.hpp:30-89), which funnels every local factorization
+through four wrappers: potrf, trtri, geqrf, orgqr.  Here the same seam maps
+to lax.linalg primitives, which XLA compiles to MXU-friendly blocked
+routines:
+
+    LAPACKE_dpotrf  ->  potrf   (lax.linalg.cholesky)
+    LAPACKE_dtrtri  ->  trtri   (lax.linalg.triangular_solve vs identity)
+    LAPACKE_dgeqrf  ->  geqrf   (jnp.linalg.qr)   [reference wrappers exist
+    LAPACKE_dorgqr  ->  orgqr   (jnp.linalg.qr)    but no algorithm calls
+                                                   them — kept for parity]
+
+These operate on *local/replicated* values: distributed algorithms gather or
+replicate a panel first (see models/cholesky.py base case), exactly where the
+reference gathers panels across the slice communicator before its local
+LAPACK call (cholinv policy.h:160-224).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def potrf(A: jnp.ndarray, uplo: str = "U") -> jnp.ndarray:
+    """Cholesky factor of SPD A: upper R with A = RᵀR (uplo='U') or lower L
+    with A = LLᵀ (uplo='L').  Reference lapack::engine::_potrf
+    (interface.hpp:30-44)."""
+    L = lax.linalg.cholesky(A)
+    return L.T if uplo == "U" else L
+
+
+def trtri(T: jnp.ndarray, uplo: str = "U", unit_diag: bool = False) -> jnp.ndarray:
+    """Inverse of a triangular matrix.  Reference lapack::engine::_trtri
+    (interface.hpp:46-59)."""
+    eye = jnp.eye(T.shape[-1], dtype=T.dtype)
+    return lax.linalg.triangular_solve(
+        T, eye, left_side=True, lower=(uplo == "L"), unit_diagonal=unit_diag
+    )
+
+
+def potrf_trtri(A: jnp.ndarray, uplo: str = "U") -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Fused base-case pair: factor + triangular inverse in one call — the
+    reference base case always computes both back to back
+    (cholinv policy.h:197-201)."""
+    R = potrf(A, uplo)
+    return R, trtri(R, uplo)
+
+
+def geqrf(A: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Householder QR returning (Q, R) — the combined geqrf+orgqr capability
+    (reference interface.hpp:61-89; upstream never calls these, see
+    SURVEY §2 row 9)."""
+    return jnp.linalg.qr(A, mode="reduced")
+
+
+def orgqr(A: jnp.ndarray) -> jnp.ndarray:
+    """Explicit Q from a Householder factorization (parity wrapper)."""
+    return jnp.linalg.qr(A, mode="reduced")[0]

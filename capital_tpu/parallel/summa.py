@@ -56,6 +56,7 @@ class GemmArgs:
     beta: float = 0.0
     trans_a: bool = False
     trans_b: bool = False
+    precision: str | None = None  # None = context default; 'highest' = f32 MXU
 
 
 @dataclasses.dataclass(frozen=True)
@@ -67,6 +68,7 @@ class TrmmArgs:
     trans_a: bool = False
     diag: str = "N"  # 'N' non-unit, 'U' unit diagonal
     alpha: float = 1.0
+    precision: str | None = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -77,6 +79,7 @@ class SyrkArgs:
     trans: bool = False  # False: C = a*A*Aᵀ + b*C ; True: C = a*AᵀA + b*C
     alpha: float = 1.0
     beta: float = 0.0
+    precision: str | None = None
 
 
 def _pin(grid: Grid, x: jnp.ndarray) -> jnp.ndarray:
@@ -89,7 +92,9 @@ def _pin(grid: Grid, x: jnp.ndarray) -> jnp.ndarray:
 # --------------------------------------------------------------------------
 
 
-def _explicit_matmul(grid: Grid, A: jnp.ndarray, B: jnp.ndarray) -> jnp.ndarray:
+def _explicit_matmul(
+    grid: Grid, A: jnp.ndarray, B: jnp.ndarray, precision: str | None = None
+) -> jnp.ndarray:
     """C = A @ B with the explicit SUMMA step schedule on the d x d x c grid.
 
     Schedule (mirrors summa.hpp:177-249, re-expressed with axis collectives):
@@ -128,7 +133,7 @@ def _explicit_matmul(grid: Grid, A: jnp.ndarray, B: jnp.ndarray) -> jnp.ndarray:
             k = zi * steps_per_layer + i
             a_panel = lax.psum(jnp.where(yi == k, a, jnp.zeros_like(a)), "y")
             b_panel = lax.psum(jnp.where(xi == k, b, jnp.zeros_like(b)), "x")
-            return acc + a_panel @ b_panel
+            return acc + jnp.matmul(a_panel, b_panel, precision=precision)
 
         acc = jnp.zeros((a.shape[0], b.shape[1]), dtype=jnp.result_type(a, b))
         acc = lax.pcast(acc, ("x", "y", "z"), to="varying")  # device-varying carry
@@ -148,11 +153,17 @@ def _explicit_matmul(grid: Grid, A: jnp.ndarray, B: jnp.ndarray) -> jnp.ndarray:
 # --------------------------------------------------------------------------
 
 
-def _matmul(grid: Grid, A: jnp.ndarray, B: jnp.ndarray, mode: str) -> jnp.ndarray:
+def _matmul(
+    grid: Grid,
+    A: jnp.ndarray,
+    B: jnp.ndarray,
+    mode: str,
+    precision: str | None = None,
+) -> jnp.ndarray:
     if mode == "xla":
-        return _pin(grid, _pin(grid, A) @ _pin(grid, B))
+        return _pin(grid, jnp.matmul(_pin(grid, A), _pin(grid, B), precision=precision))
     if mode == "explicit":
-        return _explicit_matmul(grid, A, B)
+        return _explicit_matmul(grid, A, B, precision)
     raise ValueError(f"unknown summa mode {mode!r}")
 
 
@@ -169,7 +180,7 @@ def gemm(
     Bop = B.T if args.trans_b else B
     if args.beta != 0.0 and C is None:
         raise ValueError("beta != 0 requires the accumulate operand C")
-    out = _matmul(grid, Aop, Bop, mode)
+    out = _matmul(grid, Aop, Bop, mode, args.precision)
     if args.alpha != 1.0:
         out = args.alpha * out
     if args.beta != 0.0:
@@ -194,9 +205,9 @@ def trmm(
         T = masking.with_unit_diagonal(T)
     Top = T.T if args.trans_a else T
     if args.side == "L":
-        out = _matmul(grid, Top, B, mode)
+        out = _matmul(grid, Top, B, mode, args.precision)
     elif args.side == "R":
-        out = _matmul(grid, B, Top, mode)
+        out = _matmul(grid, B, Top, mode, args.precision)
     else:
         raise ValueError(f"side must be 'L' or 'R', got {args.side!r}")
     if args.alpha != 1.0:
@@ -222,7 +233,7 @@ def syrk(
     if args.beta != 0.0 and C is None:
         raise ValueError("beta != 0 requires the accumulate operand C")
     Aop = (A.T, A) if args.trans else (A, A.T)
-    out = _matmul(grid, Aop[0], Aop[1], mode)
+    out = _matmul(grid, Aop[0], Aop[1], mode, args.precision)
     if args.alpha != 1.0:
         out = args.alpha * out
     if args.beta != 0.0:

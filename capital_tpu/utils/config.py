@@ -1,0 +1,57 @@
+"""Runtime configuration enums and dataclasses.
+
+The reference configures algorithms through four mechanisms (SURVEY §5.6):
+positional argv, env vars, compile-time -D flags, and — the real one —
+template policy selection (e.g. cholinv<Serialize,SaveIntermediates,
+NoReplication>, bench/cholesky/cholinv.cpp:31-33).  JAX retracing replaces
+template instantiation, so every policy becomes a runtime enum here; configs
+hash into jit static args, giving one compiled executable per configuration,
+exactly like one template instantiation per policy combination.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class BaseCasePolicy(enum.Enum):
+    """Base-case execution strategies (reference cholinv/policy.h:160-514).
+
+    The reference trades replicated computation against gather/scatter
+    communication on CPU clusters.  On a TPU mesh the trade collapses:
+    replicating a small panel (one all_gather over ICI) and computing it
+    redundantly on every chip is strictly cheaper than gathering to a root
+    chip and scattering back, because redundant small-matrix compute is free
+    relative to the extra collectives and the idle mesh (SURVEY §7.1).  All
+    four policies are accepted for config/sweep parity; they select the
+    gather scope used before the local potrf+trtri:
+
+      REPLICATE_COMM_COMP   gather to every device, all compute (TPU default;
+                            reference policy.h:160-224 'ReplicateCommComp')
+      REPLICATE_COMP        reference computes on layer z=0 then bcasts
+                            (policy.h:226-305); on TPU identical collective
+                            traffic to the above with strictly less useful
+                            work per chip — implemented as the same schedule
+      NO_REPLICATION        reference gathers to the single root rank
+                            (policy.h:307-414); the TPU mapping places no
+                            explicit constraint on the panel and lets the
+                            SPMD partitioner choose placement (which may
+                            gather to fewer devices) — see
+                            models/cholesky.py:_base_case
+      NO_REPLICATION_OVERLAP reference overlaps the scatter with trtri
+                            (policy.h:416-514); XLA's latency-hiding
+                            scheduler owns overlap on TPU — same mapping as
+                            NO_REPLICATION
+    """
+
+    REPLICATE_COMM_COMP = 0
+    REPLICATE_COMP = 1
+    NO_REPLICATION = 2
+    NO_REPLICATION_OVERLAP = 3
+
+    @property
+    def single_device_compute(self) -> bool:
+        return self in (
+            BaseCasePolicy.NO_REPLICATION,
+            BaseCasePolicy.NO_REPLICATION_OVERLAP,
+        )

@@ -68,6 +68,18 @@ def symmetric(
     return out.astype(dtype)
 
 
+def _splitmix64(x: np.ndarray) -> np.ndarray:
+    """SplitMix64 finalizer — a nonlinear hash over uint64, vectorized.
+    uint64 wrap-around mod 2^64 is the intended semantics; errstate silences
+    numpy's scalar/0-d overflow warnings."""
+    x = np.asarray(x, dtype=np.uint64)
+    with np.errstate(over="ignore"):
+        x = x + np.uint64(0x9E3779B97F4A7C15)
+        x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        return x ^ (x >> np.uint64(31))
+
+
 def random(
     m: int,
     n: int,
@@ -80,19 +92,20 @@ def random(
 
     The reference's ``distribute_random`` (structure.hpp:106-130) seeds once
     and draws in local element order, which makes the global content depend on
-    the grid shape — a latent bug for cross-grid validation.  Here every
-    element is coordinate-seeded (``key*M*N + r*N + c``) like the symmetric
-    filler, so the global matrix is grid-independent by construction
-    (improvement noted in SURVEY §4).
+    the grid shape — a latent bug for cross-grid validation.  Coordinate
+    seeding fixes that, but the rand48 *first draw* is affine in the seed, so
+    sequentially-seeded elements would be linearly correlated (catastrophic
+    conditioning for QR test matrices).  Hence: coordinate seed -> splitmix64
+    hash -> [0,1).  Grid-independent and full-rank-quality.
     """
     r = np.arange(m, dtype=np.uint64)[rows if rows is not None else slice(None)]
     c = np.arange(n, dtype=np.uint64)[cols if cols is not None else slice(None)]
-    seeds = (
-        np.uint64(key) * np.uint64(m) * np.uint64(n)
-        + r[:, None] * np.uint64(n)
-        + c[None, :]
-    )
-    return drand48_from_seed(seeds).astype(dtype)
+    # hash the key first so distinct (key, shape) streams occupy disjoint
+    # regions of seed space instead of overlapping arithmetically
+    base = _splitmix64(np.uint64(key))
+    seeds = base + r[:, None] * np.uint64(n) + c[None, :]
+    vals = (_splitmix64(seeds) >> np.uint64(11)).astype(np.float64) / float(1 << 53)
+    return vals.astype(dtype)
 
 
 def identity(m: int, n: int, dtype=np.float64) -> np.ndarray:

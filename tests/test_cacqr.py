@@ -1,0 +1,105 @@
+"""M4 tests: CholeskyQR / CholeskyQR2 across regimes, solve, apply_Q/QT."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from capital_tpu.models import qr
+from capital_tpu.models.cholesky import CholinvConfig
+from capital_tpu.models.qr import CacqrConfig
+from capital_tpu.utils import rand48, residual
+
+
+def _tall(m, n, key=11):
+    return jnp.asarray(rand48.random(m, n, key=key))
+
+
+class TestCQR2_1D:
+    def test_orthogonality_and_residual(self, grid_flat8):
+        g = grid_flat8
+        A = jax.device_put(_tall(1024, 64), g.rows_sharding())
+        Q, R = jax.jit(lambda a: qr.factor(g, a, CacqrConfig(regime="1d")))(A)
+        assert residual.qr_orthogonality(Q) < 1e-14
+        assert residual.qr_residual(A, Q, R) < 1e-13
+        assert np.allclose(np.asarray(R), np.triu(np.asarray(R)))
+
+    def test_cqr1_vs_cqr2_orthogonality(self, grid_flat8):
+        # CQR2's second sweep must tighten orthogonality vs plain CQR
+        g = grid_flat8
+        # genuinely ill-conditioned (cond=1e6, singular directions not axis-
+        # aligned, so R cannot absorb the scaling): A = Q0 diag(s) Vᵀ
+        Q0, _ = np.linalg.qr(np.asarray(_tall(2048, 32)))
+        V, _ = np.linalg.qr(np.asarray(rand48.random(32, 32, key=12)))
+        A = jnp.asarray(Q0 * np.logspace(0, 6, 32)[None, :] @ V.T)
+        q1, _ = qr.factor(g, A, CacqrConfig(num_iter=1, regime="1d"))
+        q2, _ = qr.factor(g, A, CacqrConfig(num_iter=2, regime="1d"))
+        o1 = float(residual.qr_orthogonality(q1))
+        o2 = float(residual.qr_orthogonality(q2))
+        assert o2 < o1 * 1e-2
+        assert o2 < 1e-13
+
+
+class TestCQR2_Dist:
+    def test_dist_regime(self, grid2x2x2):
+        g = grid2x2x2
+        A = jax.device_put(_tall(512, 64), g.face_sharding())
+        cfg = CacqrConfig(
+            regime="dist", cholinv=CholinvConfig(base_case_dim=16, complete_inv=True)
+        )
+        Q, R = jax.jit(lambda a: qr.factor(g, a, cfg))(A)
+        assert residual.qr_orthogonality(Q) < 1e-14
+        assert residual.qr_residual(A, Q, R) < 1e-13
+
+    def test_dist_blocked_solve_path(self, grid2x2x1):
+        # complete_inv=False exercises the 2x2 blocked TRSM (cacqr.hpp:46-73)
+        g = grid2x2x1
+        A = _tall(256, 64)
+        cfg = CacqrConfig(
+            regime="dist", cholinv=CholinvConfig(base_case_dim=16, complete_inv=False)
+        )
+        Q, R = qr.factor(g, A, cfg)
+        assert residual.qr_orthogonality(Q) < 1e-14
+        assert residual.qr_residual(A, Q, R) < 1e-13
+
+    def test_solve_single_base_case_window(self, grid2x2x1):
+        g = grid2x2x1
+        A = _tall(128, 16)
+        cfg = CacqrConfig(
+            regime="dist", cholinv=CholinvConfig(base_case_dim=32, complete_inv=False)
+        )
+        Q, R = qr.factor(g, A, cfg)
+        assert residual.qr_orthogonality(Q) < 1e-14
+
+    def test_auto_regime_picks_1d_for_small_n(self, grid2x2x2):
+        cfg = CacqrConfig(regime="auto")
+        assert qr._pick_regime(grid2x2x2, 64, cfg) == "1d"
+        assert qr._pick_regime(grid2x2x2, 8192, cfg) == "dist"
+        cfg2 = CacqrConfig(regime="dist")
+        assert qr._pick_regime(grid2x2x2, 64, cfg2) == "dist"
+
+
+class TestApply:
+    def test_apply_q_and_qt(self, grid_flat8):
+        g = grid_flat8
+        A = _tall(512, 32)
+        Q, R = qr.factor(g, A, CacqrConfig(regime="1d"))
+        X = jnp.asarray(rand48.random(32, 16, key=13))
+        np.testing.assert_allclose(
+            np.asarray(qr.apply_Q(g, Q, X)), np.asarray(Q) @ np.asarray(X), rtol=1e-12, atol=1e-14
+        )
+        # apply_QT: reference never implemented it (cacqr.hpp:284); we do.
+        Y = jnp.asarray(rand48.random(512, 8, key=14))
+        np.testing.assert_allclose(
+            np.asarray(qr.apply_QT(g, Q, Y)),
+            np.asarray(Q).T @ np.asarray(Y),
+            rtol=1e-12,
+            atol=1e-14,
+        )
+
+    def test_bad_inputs(self, grid_flat8):
+        A = _tall(16, 64)  # wide, not tall
+        with pytest.raises(ValueError):
+            qr.factor(grid_flat8, A)
+        with pytest.raises(ValueError):
+            qr.factor(grid_flat8, _tall(64, 16), CacqrConfig(num_iter=3))

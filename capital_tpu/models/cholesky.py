@@ -159,10 +159,7 @@ def _base_case(
     if not cfg.policy.single_device_compute:
         panel = lax.with_sharding_constraint(panel, grid.replicated_sharding())
     R, Rinv = lapack.potrf_trtri(panel, uplo="U")
-    pin = lambda x: lax.with_sharding_constraint(
-        x.astype(A.dtype), grid.face_sharding()
-    )
-    return pin(R), pin(Rinv)
+    return grid.pin(R.astype(A.dtype)), grid.pin(Rinv.astype(A.dtype))
 
 
 def _recurse(
@@ -215,8 +212,7 @@ def _recurse(
     zeros21 = jnp.zeros((A.shape[0] - n1, n1), dtype=A.dtype)
     R = jnp.block([[R11, R12], [zeros21, R22]])
     Rinv = jnp.block([[R11inv, R12inv], [zeros21, R22inv]])
-    pin = lambda x: lax.with_sharding_constraint(x, grid.face_sharding())
-    return pin(R), pin(Rinv)
+    return grid.pin(R), grid.pin(Rinv)
 
 
 def factor(
@@ -241,7 +237,7 @@ def factor(
         Ap = Ap + jnp.diag((ii >= n).astype(A.dtype))
     else:
         Ap = A
-    Ap = lax.with_sharding_constraint(Ap, grid.face_sharding())
+    Ap = grid.pin(Ap)
     R, Rinv = _recurse(grid, Ap, plan(p, cfg), cfg, top=True)
     if p != n:
         R, Rinv = R[:n, :n], Rinv[:n, :n]

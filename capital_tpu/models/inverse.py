@@ -1,0 +1,147 @@
+"""Distributed matrix inversion: recursive triangular inverse + Newton-Schulz.
+
+Two capabilities from the reference's inverse family, both finished here
+(the reference left them incomplete):
+
+* ``rectri`` — recursive triangular inversion.  The reference's
+  inverse::rectri wrote the nested-grid redistribution (`simulate`,
+  rectri.hpp:36-58) but `invert` only performs the deepest local trtri; the
+  cross-level assembly is a commented-out TODO sketch (rectri.hpp:70-99).
+  Here the full algorithm runs: for lower-triangular L
+
+      L⁻¹ = [[     L11⁻¹     ,   0  ]
+             [−L22⁻¹·L21·L11⁻¹, L22⁻¹]]
+
+  as a trace-time recursion with SUMMA gemms for the off-diagonal block.
+  The reference's nested-grid Alltoall redistribution (shrinking subcube
+  meshes per level) has no TPU analog worth keeping: windows shrink but stay
+  on the full mesh, and XLA reshards slices as needed (SURVEY §7.3 item 5).
+
+* ``newton`` — Newton-Schulz iterative inversion.  The reference's version
+  is bit-rotted and does not compile (newton.h:16-18 invalid ctor syntax;
+  newton.hpp:14-35 calls a matrix API that no longer exists).  The working
+  re-implementation is a jitted lax.while_loop: X ← X(2I − AX) with the
+  spectral-safe initialization X₀ = Aᵀ/(‖A‖₁·‖A‖∞) and early exit on
+  ‖I − AX‖_F < tol — the same iteration newton.hpp:42-53 sketches, including
+  its early-exit residual check.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+from jax import lax
+
+from capital_tpu.ops import lapack
+from capital_tpu.parallel import summa
+from capital_tpu.parallel.summa import GemmArgs
+from capital_tpu.parallel.topology import Grid
+
+
+@dataclasses.dataclass(frozen=True)
+class RectriConfig:
+    """Knobs for the recursive triangular inverse (reference rectri policies,
+    rectri/policy.h, reduced to their working essence)."""
+
+    base_case_dim: int = 256
+    mode: str = "xla"
+    precision: str | None = "highest"
+
+
+def rectri(
+    grid: Grid,
+    T: jnp.ndarray,
+    uplo: str = "L",
+    cfg: RectriConfig = RectriConfig(),
+) -> jnp.ndarray:
+    """Inverse of triangular T (the completed inverse::rectri::invoke,
+    reference rectri.hpp:60-99).  jit-friendly trace-time recursion."""
+    if uplo not in ("L", "U"):
+        raise ValueError(f"uplo must be 'L' or 'U', got {uplo!r}")
+    n = T.shape[0]
+    if T.shape[0] != T.shape[1]:
+        raise ValueError(f"triangular operand must be square, got {T.shape}")
+
+    if uplo == "U":
+        # U⁻¹ = (Lᵀ)⁻¹ = (L⁻¹)ᵀ with L = Uᵀ: one transpose each way keeps a
+        # single recursion body (the reference instantiates both via policy).
+        return summa.transpose(grid, rectri(grid, summa.transpose(grid, T), "L", cfg))
+
+    if n <= cfg.base_case_dim:
+        Tr = lax.with_sharding_constraint(T, grid.replicated_sharding())
+        return grid.pin(lapack.trtri(Tr, uplo="L"))
+
+    n1 = n // 2
+    L11inv = rectri(grid, T[:n1, :n1], "L", cfg)
+    L22inv = rectri(grid, T[n1:, n1:], "L", cfg)
+    # B21 = −L22⁻¹ · L21 · L11⁻¹  (the TODO sketch at rectri.hpp:70-99)
+    gargs = GemmArgs(precision=cfg.precision)
+    M = summa.gemm(grid, T[n1:, :n1], L11inv, args=gargs, mode=cfg.mode)
+    B21 = summa.gemm(
+        grid,
+        L22inv,
+        M,
+        args=GemmArgs(alpha=-1.0, precision=cfg.precision),
+        mode=cfg.mode,
+    )
+    zeros12 = jnp.zeros((n1, n - n1), dtype=T.dtype)
+    out = jnp.block([[L11inv, zeros12], [B21, L22inv]])
+    return grid.pin(out)
+
+
+@dataclasses.dataclass(frozen=True)
+class NewtonConfig:
+    """Newton-Schulz iteration knobs (reference inverse::newton::info,
+    newton.h:20-29: tolerance + max_iter)."""
+
+    tol: float = 1e-12
+    max_iter: int = 100
+    mode: str = "xla"
+    precision: str | None = "highest"
+
+
+def newton(
+    grid: Grid, A: jnp.ndarray, cfg: NewtonConfig = NewtonConfig()
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Iterative inverse of (well-conditioned) A by Newton-Schulz.
+
+    Returns (Ainv, num_iters).  The working replacement for the bit-rotted
+    inverse::newton (reference newton.hpp:14-53): X₀ = Aᵀ/(‖A‖₁‖A‖∞)
+    guarantees ‖I − AX₀‖ < 1; the loop doubles correct digits per step and
+    exits early on the residual check — the reference's convergence test at
+    newton.hpp:49-52 — expressed as a lax.while_loop (no data-dependent
+    Python control flow under jit).
+    """
+    n = A.shape[0]
+    pin = lambda x: grid.pin(x)
+    A = pin(A)
+    eye = pin(jnp.eye(n, dtype=A.dtype))
+    # ‖A‖₁ = max col abs sum, ‖A‖∞ = max row abs sum (the reference computes
+    # the row-sum norm via row-comm allreduce + slice max, newton.hpp:27-35;
+    # here both are global reductions XLA lowers to the same collectives)
+    norm1 = jnp.max(jnp.sum(jnp.abs(A), axis=0))
+    norminf = jnp.max(jnp.sum(jnp.abs(A), axis=1))
+    X0 = pin(A.T / (norm1 * norminf))
+
+    gargs = GemmArgs(precision=cfg.precision)
+
+    def resid(AX):
+        return jnp.linalg.norm(eye - AX) / jnp.sqrt(jnp.asarray(n, A.dtype))
+
+    def cond(state):
+        _, _, r, it = state
+        return jnp.logical_and(r > cfg.tol, it < cfg.max_iter)
+
+    def body(state):
+        # carry AX from the previous step: 2 distributed gemms per iteration
+        X, AX, _, it = state
+        Xn = summa.gemm(grid, X, 2.0 * eye - AX, args=gargs, mode=cfg.mode)  # X(2I−AX)
+        AXn = summa.gemm(grid, A, Xn, args=gargs, mode=cfg.mode)
+        return (pin(Xn), AXn, resid(AXn), it + 1)
+
+    AX0 = summa.gemm(grid, A, X0, args=gargs, mode=cfg.mode)
+    X, _, r, iters = lax.while_loop(
+        cond, body, (X0, AX0, resid(AX0), jnp.asarray(0, jnp.int32))
+    )
+    return X, iters

@@ -115,7 +115,7 @@ def _sweep_dist(
     cacqr.hpp:103), then Q = A·R⁻¹ by SUMMA trmm — or, when cholinv is run
     without the completed inverse, the 2x2 blocked solve (cacqr.hpp:46-73).
     """
-    A = lax.with_sharding_constraint(A, grid.face_sharding())
+    A = grid.pin(A)
     G = summa.syrk(
         grid, A, args=SyrkArgs(trans=True, precision=cfg.precision), mode=cfg.mode
     )
@@ -169,9 +169,7 @@ def solve_blocked(
         grid, R22inv, A2p,
         TrmmArgs(side="R", uplo="U", precision=cfg.precision), mode=cfg.mode,
     )
-    return lax.with_sharding_constraint(
-        jnp.concatenate([X1, X2], axis=1), grid.face_sharding()
-    )
+    return grid.pin(jnp.concatenate([X1, X2], axis=1))
 
 
 # --------------------------------------------------------------------------

@@ -1,0 +1,117 @@
+"""Distributed triangular solve (TRSM).
+
+The reference's trsm::diaginvert is a stub — `solve` is
+``static_assert(0, "not implemented")`` (reference src/alg/trsm/diaginvert/
+diaginvert.hpp:9) and the only working triangular solve is the 2x2 blocked
+special case buried in cacqr (cacqr.hpp:46-73).  This module implements the
+capability properly: a recursive blocked TRSM on the device grid, with all
+four side/uplo combinations and transpose support.
+
+Schedule (lower-triangular, side='L' shown; others by symmetry):
+
+    [L11  0 ] [X1]   [B1]      X1 = trsm(L11, B1)
+    [L21 L22] [X2] = [B2]  ->  X2 = trsm(L22, B2 − L21·X1)
+
+The recursion is trace-time (static windows, like models/cholesky.py); the
+base case replicates the triangular panel and runs
+lax.linalg.triangular_solve on every chip — same policy argument as the
+cholinv base case (SURVEY §7.1: replicate-and-recompute is the TPU-optimal
+base-case strategy).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+from jax import lax
+
+from capital_tpu.parallel import summa
+from capital_tpu.parallel.summa import GemmArgs
+from capital_tpu.parallel.topology import Grid
+
+
+@dataclasses.dataclass(frozen=True)
+class TrsmConfig:
+    """Blocked-TRSM knobs (the reference's diaginvert policies were only
+    forward-declared, trsm/diaginvert/policy.h:8-9; these are the working
+    equivalents)."""
+
+    base_case_dim: int = 256
+    mode: str = "xla"
+    precision: str | None = "highest"
+
+
+def _base_solve(
+    grid: Grid, T: jnp.ndarray, B: jnp.ndarray, lower: bool, left: bool
+) -> jnp.ndarray:
+    Tr = lax.with_sharding_constraint(T, grid.replicated_sharding())
+    X = lax.linalg.triangular_solve(Tr, B, left_side=left, lower=lower)
+    return grid.pin(X)
+
+
+def solve(
+    grid: Grid,
+    A: jnp.ndarray,
+    B: jnp.ndarray,
+    side: str = "L",
+    uplo: str = "L",
+    trans_a: bool = False,
+    cfg: TrsmConfig = TrsmConfig(),
+) -> jnp.ndarray:
+    """X with op(tri(A)) @ X = B (side='L') or X @ op(tri(A)) = B (side='R').
+
+    The working replacement for trsm::diaginvert::solve
+    (reference diaginvert.hpp:9).  jit-friendly; recursion is trace-time.
+    """
+    if side not in ("L", "R"):
+        raise ValueError(f"side must be 'L' or 'R', got {side!r}")
+    if uplo not in ("L", "U"):
+        raise ValueError(f"uplo must be 'L' or 'U', got {uplo!r}")
+    n = A.shape[0]
+    if A.shape[0] != A.shape[1]:
+        raise ValueError(f"triangular operand must be square, got {A.shape}")
+    need = B.shape[0] if side == "L" else B.shape[1]
+    if need != n:
+        raise ValueError(f"shape mismatch: A {A.shape} vs B {B.shape} side={side}")
+
+    lower = uplo == "L"
+    if trans_a:
+        # op(T) x = b  <=>  solve with the transposed triangle; fold the
+        # transpose into the effective uplo and recurse untransposed.
+        return solve(
+            grid, summa.transpose(grid, A), B, side, "U" if lower else "L", False, cfg
+        )
+
+    if n <= cfg.base_case_dim:
+        return _base_solve(grid, A, B, lower, left=(side == "L"))
+
+    n1 = n // 2
+    A11 = A[:n1, :n1]
+    A22 = A[n1:, n1:]
+    gargs = GemmArgs(alpha=-1.0, beta=1.0, precision=cfg.precision)
+
+    if side == "L" and lower:
+        A21 = A[n1:, :n1]
+        X1 = solve(grid, A11, B[:n1, :], side, uplo, False, cfg)
+        B2 = summa.gemm(grid, A21, X1, B[n1:, :], gargs, mode=cfg.mode)
+        X2 = solve(grid, A22, B2, side, uplo, False, cfg)
+    elif side == "L" and not lower:
+        A12 = A[:n1, n1:]
+        X2 = solve(grid, A22, B[n1:, :], side, uplo, False, cfg)
+        B1 = summa.gemm(grid, A12, X2, B[:n1, :], gargs, mode=cfg.mode)
+        X1 = solve(grid, A11, B1, side, uplo, False, cfg)
+    elif side == "R" and lower:
+        A21 = A[n1:, :n1]
+        X2 = solve(grid, A22, B[:, n1:], side, uplo, False, cfg)
+        B1 = summa.gemm(grid, X2, A21, B[:, :n1], gargs, mode=cfg.mode)
+        X1 = solve(grid, A11, B1, side, uplo, False, cfg)
+    else:  # side == "R", upper
+        A12 = A[:n1, n1:]
+        X1 = solve(grid, A11, B[:, :n1], side, uplo, False, cfg)
+        B2 = summa.gemm(grid, X1, A12, B[:, n1:], gargs, mode=cfg.mode)
+        X2 = solve(grid, A22, B2, side, uplo, False, cfg)
+
+    axis = 0 if side == "L" else 1
+    X = jnp.concatenate([X1, X2], axis=axis)
+    return grid.pin(X)

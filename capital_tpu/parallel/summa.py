@@ -82,11 +82,6 @@ class SyrkArgs:
     precision: str | None = None
 
 
-def _pin(grid: Grid, x: jnp.ndarray) -> jnp.ndarray:
-    """Constrain to the face layout (rows over 'x', cols over 'y')."""
-    return lax.with_sharding_constraint(x, grid.face_sharding())
-
-
 # --------------------------------------------------------------------------
 # explicit shard_map schedule
 # --------------------------------------------------------------------------
@@ -145,7 +140,7 @@ def _explicit_matmul(
         mesh=grid.mesh,
         in_specs=(P("x", "y"), P("x", "y")),
         out_specs=P("x", "y"),
-    )(_pin(grid, A), _pin(grid, B))
+    )(grid.pin(A), grid.pin(B))
 
 
 # --------------------------------------------------------------------------
@@ -161,7 +156,7 @@ def _matmul(
     precision: str | None = None,
 ) -> jnp.ndarray:
     if mode == "xla":
-        return _pin(grid, jnp.matmul(_pin(grid, A), _pin(grid, B), precision=precision))
+        return grid.pin(jnp.matmul(grid.pin(A), grid.pin(B), precision=precision))
     if mode == "explicit":
         return _explicit_matmul(grid, A, B, precision)
     raise ValueError(f"unknown summa mode {mode!r}")
@@ -184,8 +179,8 @@ def gemm(
     if args.alpha != 1.0:
         out = args.alpha * out
     if args.beta != 0.0:
-        out = out + args.beta * _pin(grid, C)
-    return _pin(grid, out)
+        out = out + args.beta * grid.pin(C)
+    return grid.pin(out)
 
 
 def trmm(
@@ -212,7 +207,7 @@ def trmm(
         raise ValueError(f"side must be 'L' or 'R', got {args.side!r}")
     if args.alpha != 1.0:
         out = args.alpha * out
-    return _pin(grid, out)
+    return grid.pin(out)
 
 
 def syrk(
@@ -237,8 +232,8 @@ def syrk(
     if args.alpha != 1.0:
         out = args.alpha * out
     if args.beta != 0.0:
-        out = out + args.beta * _pin(grid, C)
-    return _pin(grid, out)
+        out = out + args.beta * grid.pin(C)
+    return grid.pin(out)
 
 
 def transpose(grid: Grid, A: jnp.ndarray) -> jnp.ndarray:
@@ -247,4 +242,4 @@ def transpose(grid: Grid, A: jnp.ndarray) -> jnp.ndarray:
     Reference util::transpose swaps blocks with the mirrored grid rank via
     MPI_Sendrecv_replace (util.hpp:232-247); on TPU the same data motion is
     XLA's collective-permute, emitted from the layout constraint."""
-    return _pin(grid, A.T)
+    return grid.pin(A.T)

@@ -171,6 +171,14 @@ class Grid:
     def spec(self, *spec) -> NamedSharding:
         return NamedSharding(self.mesh, P(*spec))
 
+    def pin(self, x):
+        """Constrain a 2D array to the face layout when its shape divides the
+        face, else leave placement to XLA (uneven explicit shardings are
+        rejected by jit; odd-sized recursion windows hit this)."""
+        if x.ndim == 2 and x.shape[0] % self.dx == 0 and x.shape[1] % self.dy == 0:
+            return jax.lax.with_sharding_constraint(x, self.face_sharding())
+        return x
+
     # ---- shape utilities ---------------------------------------------------
 
     def face_tile(self, m: int, n: int) -> tuple[int, int]:

@@ -100,9 +100,11 @@ def random(
     """
     r = np.arange(m, dtype=np.uint64)[rows if rows is not None else slice(None)]
     c = np.arange(n, dtype=np.uint64)[cols if cols is not None else slice(None)]
-    # hash the key first so distinct (key, shape) streams occupy disjoint
-    # regions of seed space instead of overlapping arithmetically
-    base = _splitmix64(np.uint64(key))
+    # hash key and shape together so distinct (key, shape) streams occupy
+    # unrelated regions of seed space instead of overlapping arithmetically
+    base = _splitmix64(
+        _splitmix64(np.uint64(key)) ^ ((np.uint64(m) << np.uint64(32)) | np.uint64(n))
+    )
     seeds = base + r[:, None] * np.uint64(n) + c[None, :]
     vals = (_splitmix64(seeds) >> np.uint64(11)).astype(np.float64) / float(1 << 53)
     return vals.astype(dtype)

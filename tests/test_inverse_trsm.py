@@ -1,0 +1,109 @@
+"""M5 tests: rectri, Newton-Schulz, and distributed TRSM."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from capital_tpu.models import inverse, trsm
+from capital_tpu.models.inverse import NewtonConfig, RectriConfig
+from capital_tpu.models.trsm import TrsmConfig
+from capital_tpu.utils import rand48, residual
+
+
+def _tri(n, uplo, key=21):
+    A = np.asarray(rand48.random(n, n, key=key)) + np.eye(n) * n
+    return jnp.asarray(np.tril(A) if uplo == "L" else np.triu(A))
+
+
+class TestRectri:
+    @pytest.mark.parametrize("uplo", ["L", "U"])
+    @pytest.mark.parametrize("n,bc", [(64, 16), (100, 32)])
+    def test_inverse(self, grid2x2x1, uplo, n, bc):
+        T = _tri(n, uplo)
+        Tinv = jax.jit(
+            lambda t: inverse.rectri(grid2x2x1, t, uplo, RectriConfig(base_case_dim=bc))
+        )(T)
+        assert residual.inverse_residual(T, Tinv) < 1e-13
+        # inverse of a triangular matrix is triangular with the same uplo
+        Ti = np.asarray(Tinv)
+        if uplo == "L":
+            np.testing.assert_allclose(Ti, np.tril(Ti), atol=1e-14)
+        else:
+            np.testing.assert_allclose(Ti, np.triu(Ti), atol=1e-14)
+
+    def test_on_3d_grid(self, grid2x2x2):
+        T = _tri(128, "L")
+        Td = jax.device_put(T, grid2x2x2.face_sharding())
+        Tinv = inverse.rectri(grid2x2x2, Td, "L", RectriConfig(base_case_dim=32))
+        assert residual.inverse_residual(T, Tinv) < 1e-13
+
+    def test_bad_inputs(self, grid2x2x1):
+        with pytest.raises(ValueError):
+            inverse.rectri(grid2x2x1, jnp.zeros((4, 6)))
+        with pytest.raises(ValueError):
+            inverse.rectri(grid2x2x1, jnp.eye(4), uplo="X")
+
+
+class TestNewton:
+    def test_spd_inverse(self, grid2x2x1):
+        A = jnp.asarray(rand48.symmetric(64))
+        X, iters = jax.jit(lambda a: inverse.newton(grid2x2x1, a, NewtonConfig()))(A)
+        assert residual.inverse_residual(A, X) < 1e-11
+        assert 0 < int(iters) < 60
+
+    def test_nonsymmetric(self, grid2x2x1):
+        # diagonally dominant nonsymmetric matrix
+        n = 48
+        A = jnp.asarray(np.asarray(rand48.random(n, n, key=3)) + np.eye(n) * n)
+        X, _ = inverse.newton(grid2x2x1, A)
+        assert residual.inverse_residual(A, X) < 1e-11
+
+    def test_max_iter_bound(self, grid2x2x1):
+        A = jnp.asarray(rand48.symmetric(32))
+        _, iters = inverse.newton(grid2x2x1, A, NewtonConfig(max_iter=3))
+        assert int(iters) == 3  # stopped by the bound, not converged
+
+
+class TestTrsm:
+    @pytest.mark.parametrize("side", ["L", "R"])
+    @pytest.mark.parametrize("uplo", ["L", "U"])
+    @pytest.mark.parametrize("trans_a", [False, True])
+    def test_all_variants(self, grid2x2x1, side, uplo, trans_a):
+        n, m = 64, 32
+        T = _tri(n, uplo)
+        Bshape = (n, m) if side == "L" else (m, n)
+        B = jnp.asarray(rand48.random(*Bshape, key=22))
+        X = jax.jit(
+            lambda t, b: trsm.solve(
+                grid2x2x1, t, b, side, uplo, trans_a, TrsmConfig(base_case_dim=16)
+            )
+        )(T, B)
+        Tn = np.asarray(T).T if trans_a else np.asarray(T)
+        got = Tn @ np.asarray(X) if side == "L" else np.asarray(X) @ Tn
+        np.testing.assert_allclose(got, np.asarray(B), rtol=1e-11, atol=1e-11)
+
+    def test_odd_size_recursion(self, grid2x2x1):
+        # n=100 with bc=16 exercises uneven halving (50/50 -> 25/25...)
+        T = _tri(100, "L")
+        B = jnp.asarray(rand48.random(100, 8, key=23))
+        X = trsm.solve(grid2x2x1, T, B, "L", "L", cfg=TrsmConfig(base_case_dim=16))
+        np.testing.assert_allclose(
+            np.asarray(T) @ np.asarray(X), np.asarray(B), rtol=1e-11, atol=1e-11
+        )
+
+    def test_agrees_with_rectri(self, grid2x2x1):
+        # X = T⁻¹ B two ways
+        T = _tri(64, "L")
+        B = jnp.asarray(rand48.random(64, 16, key=24))
+        X1 = trsm.solve(grid2x2x1, T, B, "L", "L", cfg=TrsmConfig(base_case_dim=32))
+        Tinv = inverse.rectri(grid2x2x1, T, "L", RectriConfig(base_case_dim=32))
+        X2 = Tinv @ B
+        np.testing.assert_allclose(np.asarray(X1), np.asarray(X2), rtol=1e-9, atol=1e-11)
+
+    def test_bad_inputs(self, grid2x2x1):
+        T = _tri(16, "L")
+        with pytest.raises(ValueError):
+            trsm.solve(grid2x2x1, T, jnp.zeros((8, 4)))  # shape mismatch
+        with pytest.raises(ValueError):
+            trsm.solve(grid2x2x1, T, jnp.zeros((16, 4)), side="X")

@@ -1,0 +1,136 @@
+"""Flagship benchmark: recursive Cholesky + triangular inverse (cholinv).
+
+Times ``cholesky.factor`` — the reference's flagship algorithm
+(bench/cholesky/cholinv.cpp) — on the available device(s) and prints ONE
+JSON line::
+
+    {"metric": "cholinv_tflops", "value": N, "unit": "TFLOP/s",
+     "vs_baseline": N, ...}
+
+``vs_baseline`` is achieved throughput over the north-star target from
+BASELINE.md: 90% of the chip's peak dense-matmul throughput at the bench
+dtype (the reference publishes no absolute numbers — its repo ships only
+the harness — so the target *is* the baseline).  Flop count for Cholesky
+factor + triangular inverse: N^3/3 + N^3/3 = 2N^3/3, times 2 sweeps of
+useful work counted conservatively as N^3/3 + N^3/3 (factor+inverse).
+
+Timing discipline: the reference driver times warmup + per-iteration walls
+(bench/cholesky/cholinv.cpp:44-59).  Dispatch through the TPU tunnel has a
+fixed ~70ms overhead and async dispatch means naive host-side walls lie, so
+the iteration loop runs INSIDE one jit (lax.fori_loop with a data-dependent
+carry), the result is synced by a host transfer, and the per-iteration time
+is the delta between an (ITERS+1)-iteration run and a 1-iteration run.
+
+Usage: python bench.py [N] [dtype] [iters]
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# peak dense-matmul TFLOP/s per chip, by (device kind substring, dtype).
+# Public numbers: v4 275 bf16; v5e 197 bf16 / 98.5 f32(fp32 via bf16x3 ~
+# counted at 1/2); v5p 459; v6e (Trillium) 918.  f32 figures are bf16/2
+# except where the MXU runs f32 natively at 1/8.
+_PEAK_BF16 = {
+    "v6e": 918.0, "v6": 918.0,
+    "v5p": 459.0, "v5": 197.0, "lite": 197.0,
+    "v4": 275.0,
+    "v3": 123.0, "v2": 45.0,
+}
+
+
+def _peak_tflops(kind: str, dtype) -> float:
+    kind = kind.lower()
+    peak = 197.0
+    for k, v in _PEAK_BF16.items():
+        if k in kind:
+            peak = v
+            break
+    if jnp.dtype(dtype).itemsize >= 4:
+        peak /= 2.0  # f32 on MXU via 2-pass bf16 (upper bound)
+    return peak
+
+
+def main() -> None:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 16384
+    dtype = jnp.dtype(sys.argv[2]) if len(sys.argv) > 2 else jnp.bfloat16
+    iters = int(sys.argv[3]) if len(sys.argv) > 3 else 3
+
+    from capital_tpu.models import cholesky
+    from capital_tpu.parallel.topology import Grid
+
+    dev = jax.devices()[0]
+    grid = Grid.square(c=1, devices=[dev])
+
+    # bf16 throughput config: trailing updates at the MXU's native precision,
+    # base case in f32 (CholinvConfig default picks f32 for narrow inputs)
+    cfg = cholesky.CholinvConfig(
+        base_case_dim=2048,
+        precision=None if jnp.dtype(dtype).itemsize < 4 else "highest",
+    )
+
+    rng = np.random.default_rng(0)
+    # SPD with strong diagonal dominance: Wigner-scaled noise + n*I, built on
+    # device to keep host memory modest at large n
+    M = jnp.asarray(rng.standard_normal((n, n)).astype(np.float32))
+
+    @jax.jit
+    def make_spd(M):
+        A = (M + M.T) / jnp.sqrt(2.0 * n)
+        return (A + 2.0 * jnp.eye(n, dtype=M.dtype)).astype(dtype)
+
+    A = jax.block_until_ready(make_spd(M))
+    del M
+
+    @jax.jit
+    def loop(a, iters):
+        def body(_, carry):
+            R, Rinv = cholesky.factor(grid, carry, cfg)
+            # data-dependent carry: perturb below dtype resolution so no
+            # iteration can be folded away, while staying numerically inert
+            return carry + jnp.asarray(1e-30, carry.dtype) * R
+
+        out = jax.lax.fori_loop(0, iters, body, a)
+        return jnp.sum(out[:1, :1])
+
+    def timed(k: int) -> float:
+        t0 = time.perf_counter()
+        float(loop(A, k))  # host transfer = real sync
+        return time.perf_counter() - t0
+
+    timed(1)  # warmup: compile (dynamic trip count -> one executable)
+    deltas = [timed(iters + 1) - timed(1) for _ in range(3)]
+    t = statistics.median(deltas) / iters
+
+    flops = 2.0 * n**3 / 3.0  # factor (n^3/3) + full triangular inverse (n^3/3)
+    tflops = flops / t / 1e12
+    target = 0.9 * _peak_tflops(dev.device_kind, dtype)
+
+    print(
+        json.dumps(
+            {
+                "metric": "cholinv_tflops",
+                "value": round(tflops, 3),
+                "unit": "TFLOP/s",
+                "vs_baseline": round(tflops / target, 4),
+                "n": n,
+                "dtype": str(jnp.dtype(dtype)),
+                "seconds": round(t, 4),
+                "device": dev.device_kind,
+                "target_tflops": round(target, 1),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -51,6 +51,7 @@ from capital_tpu.ops import lapack
 from capital_tpu.parallel import summa
 from capital_tpu.parallel.summa import SyrkArgs, TrmmArgs
 from capital_tpu.parallel.topology import Grid
+from capital_tpu.utils import tracing
 from capital_tpu.utils.config import BaseCasePolicy
 
 
@@ -155,11 +156,22 @@ def _base_case(
     bc_dtype = cfg.base_case_dtype
     if bc_dtype is None:
         bc_dtype = A.dtype if jnp.dtype(A.dtype).itemsize >= 4 else jnp.float32
-    panel = A.astype(bc_dtype)
-    if not cfg.policy.single_device_compute:
-        panel = lax.with_sharding_constraint(panel, grid.replicated_sharding())
-    R, Rinv = lapack.potrf_trtri(panel, uplo="U")
-    return grid.pin(R.astype(A.dtype)), grid.pin(Rinv.astype(A.dtype))
+    # phase tag CI::factor_diag (reference cholinv.hpp:94-99)
+    with tracing.scope("CI::factor_diag"):
+        n = A.shape[0]
+        comm, ncoll = (
+            (0.0, 0)
+            if cfg.policy.single_device_compute
+            else tracing.replicate_cost(grid, n, n, bc_dtype)
+        )
+        tracing.emit(
+            flops=tracing.potrf_trtri_flops(n), comm_bytes=comm, collectives=ncoll
+        )
+        panel = A.astype(bc_dtype)
+        if not cfg.policy.single_device_compute:
+            panel = lax.with_sharding_constraint(panel, grid.replicated_sharding())
+        R, Rinv = lapack.potrf_trtri(panel, uplo="U")
+        return grid.pin(R.astype(A.dtype)), grid.pin(Rinv.astype(A.dtype))
 
 
 def _recurse(
@@ -180,16 +192,20 @@ def _recurse(
     # 2. TRSM phase: R12 = R11⁻ᵀ · A12 (cholinv.hpp:116-123, tag CI::trsm).
     # The reference grid-transposes R11inv then trmms; here the transpose is
     # an argument flag and XLA plans the data motion.
-    R12 = summa.trmm(
-        grid, R11inv, A12, TrmmArgs(side="L", uplo="U", trans_a=True, precision=cfg.precision),
-        mode=cfg.mode
-    )
+    with tracing.scope("CI::trsm"):
+        R12 = summa.trmm(
+            grid, R11inv, A12,
+            TrmmArgs(side="L", uplo="U", trans_a=True, precision=cfg.precision),
+            mode=cfg.mode,
+        )
 
     # 3. Schur complement: A22' = A22 − R12ᵀR12 (cholinv.hpp:131-134, CI::tmu)
-    S = summa.syrk(
-        grid, R12, A22, SyrkArgs(trans=True, alpha=-1.0, beta=1.0, precision=cfg.precision),
-        mode=cfg.mode
-    )
+    with tracing.scope("CI::tmu"):
+        S = summa.syrk(
+            grid, R12, A22,
+            SyrkArgs(trans=True, alpha=-1.0, beta=1.0, precision=cfg.precision),
+            mode=cfg.mode,
+        )
 
     # 4. recurse on the trailing window (cholinv.hpp:139-142)
     R22, R22inv = _recurse(grid, S, right, cfg, top=False)
@@ -198,14 +214,16 @@ def _recurse(
     # skipped at the top level when complete_inv=False.
     zeros12 = jnp.zeros_like(R12)
     if cfg.complete_inv or not top:
-        T = summa.trmm(
-            grid, R11inv, R12,
-            TrmmArgs(side="L", uplo="U", precision=cfg.precision), mode=cfg.mode,
-        )
-        R12inv = summa.trmm(
-            grid, R22inv, T, TrmmArgs(side="R", uplo="U", alpha=-1.0, precision=cfg.precision),
-            mode=cfg.mode
-        )
+        with tracing.scope("CI::inv"):
+            T = summa.trmm(
+                grid, R11inv, R12,
+                TrmmArgs(side="L", uplo="U", precision=cfg.precision), mode=cfg.mode,
+            )
+            R12inv = summa.trmm(
+                grid, R22inv, T,
+                TrmmArgs(side="R", uplo="U", alpha=-1.0, precision=cfg.precision),
+                mode=cfg.mode,
+            )
     else:
         R12inv = zeros12
 

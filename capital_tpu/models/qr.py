@@ -53,6 +53,7 @@ from capital_tpu.ops import lapack
 from capital_tpu.parallel import summa
 from capital_tpu.parallel.summa import GemmArgs, SyrkArgs, TrmmArgs
 from capital_tpu.parallel.topology import Grid
+from capital_tpu.utils import tracing
 
 
 @dataclasses.dataclass(frozen=True)
@@ -95,14 +96,26 @@ def _sweep_1d(
     partial product and the all-axis psum, the exact analog of the
     reference's local syrk + MPI_Allreduce over world (cacqr.hpp:14-25).
     """
+    m, n = A.shape
     A = lax.with_sharding_constraint(A, grid.rows_sharding())
-    G = lax.with_sharding_constraint(
-        jnp.matmul(A.T, A, precision=precision), grid.replicated_sharding()
-    )
-    R, Rinv = lapack.potrf_trtri(G, uplo="U")
-    Q = lax.with_sharding_constraint(
-        jnp.matmul(A, Rinv, precision=precision), grid.rows_sharding()
-    )
+    # phase tags follow the reference symbols CQR::gram / CQR::formR
+    # (cacqr.hpp:82-116)
+    with tracing.scope("CQR::gram"):
+        comm, ncoll = tracing.allreduce_cost(grid, n, n, A.dtype, axes="all")
+        tracing.emit(
+            flops=2.0 * m * n * n / grid.num_devices, comm_bytes=comm, collectives=ncoll
+        )
+        G = lax.with_sharding_constraint(
+            jnp.matmul(A.T, A, precision=precision), grid.replicated_sharding()
+        )
+    with tracing.scope("CQR::chol"):
+        tracing.emit(flops=tracing.potrf_trtri_flops(n))
+        R, Rinv = lapack.potrf_trtri(G, uplo="U")
+    with tracing.scope("CQR::formR"):
+        tracing.emit(flops=2.0 * m * n * n / grid.num_devices)
+        Q = lax.with_sharding_constraint(
+            jnp.matmul(A, Rinv, precision=precision), grid.rows_sharding()
+        )
     return Q, R
 
 
@@ -116,17 +129,20 @@ def _sweep_dist(
     without the completed inverse, the 2x2 blocked solve (cacqr.hpp:46-73).
     """
     A = grid.pin(A)
-    G = summa.syrk(
-        grid, A, args=SyrkArgs(trans=True, precision=cfg.precision), mode=cfg.mode
-    )
-    R, Rinv = cholesky.factor(grid, G, cfg.cholinv)
-    if cfg.cholinv.complete_inv:
-        Q = summa.trmm(
-            grid, Rinv, A,
-            TrmmArgs(side="R", uplo="U", precision=cfg.precision), mode=cfg.mode,
+    with tracing.scope("CQR::gram"):
+        G = summa.syrk(
+            grid, A, args=SyrkArgs(trans=True, precision=cfg.precision), mode=cfg.mode
         )
-    else:
-        Q = solve_blocked(grid, A, R, Rinv, cfg)
+    with tracing.scope("CQR::chol"):
+        R, Rinv = cholesky.factor(grid, G, cfg.cholinv)
+    with tracing.scope("CQR::formR"):
+        if cfg.cholinv.complete_inv:
+            Q = summa.trmm(
+                grid, Rinv, A,
+                TrmmArgs(side="R", uplo="U", precision=cfg.precision), mode=cfg.mode,
+            )
+        else:
+            Q = solve_blocked(grid, A, R, Rinv, cfg)
     return Q, R
 
 
@@ -209,13 +225,16 @@ def factor(
     if cfg.num_iter == 2:
         Q, R2 = sweep(Q)
         # merge R = R2 · R1: both upper triangular; small local/distributed trmm
-        if regime == "1d":
-            R = jnp.matmul(jnp.triu(R2), jnp.triu(R), precision=cfg.precision)
-        else:
-            R = summa.trmm(
-                grid, R2, R,
-                TrmmArgs(side="L", uplo="U", precision=cfg.precision), mode=cfg.mode,
-            )
+        # (reference cacqr.hpp:181-189, 204-210)
+        with tracing.scope("CQR::merge"):
+            if regime == "1d":
+                tracing.emit(flops=2.0 * R.shape[0] ** 3)
+                R = jnp.matmul(jnp.triu(R2), jnp.triu(R), precision=cfg.precision)
+            else:
+                R = summa.trmm(
+                    grid, R2, R,
+                    TrmmArgs(side="L", uplo="U", precision=cfg.precision), mode=cfg.mode,
+                )
     return Q, R
 
 

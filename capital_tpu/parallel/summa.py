@@ -46,6 +46,7 @@ from jax.sharding import PartitionSpec as P
 
 from capital_tpu.ops import masking
 from capital_tpu.parallel.topology import Grid
+from capital_tpu.utils import tracing
 
 
 @dataclasses.dataclass(frozen=True)
@@ -155,6 +156,11 @@ def _matmul(
     mode: str,
     precision: str | None = None,
 ) -> jnp.ndarray:
+    # cost-model attribution (no-op without an active tracing.Recorder)
+    flops, comm, ncoll = tracing.gemm_cost(
+        grid, A.shape[0], B.shape[1], A.shape[1], jnp.result_type(A, B)
+    )
+    tracing.emit(flops=flops, comm_bytes=comm, collectives=ncoll)
     if mode == "xla":
         return grid.pin(jnp.matmul(grid.pin(A), grid.pin(B), precision=precision))
     if mode == "explicit":

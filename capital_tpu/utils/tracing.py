@@ -1,0 +1,354 @@
+"""Tracing, named scopes, and the communication/computation cost model.
+
+TPU-native re-imagining of the reference's critter profiling integration
+(SURVEY §5.1).  The reference compile-gates symbol macros around functions and
+algorithm phases (``CRITTER_START(CI::trsm)`` etc., cholinv.hpp:94-136,
+cacqr.hpp:82-116) and the external critter library measures per-symbol
+computation/communication costs along the critical path, per process, and
+volumetrically (autotune/cholesky/cholinv/tune.cpp:28-88).
+
+On TPU the execution model is different: everything inside ``jit`` is compiled
+into one XLA program, so per-phase *measurement* from Python is impossible —
+the phases fuse.  The equivalent design here has three parts:
+
+1. **Named scopes** (`scope`): phase tags (the same names the reference uses —
+   ``CI::trsm``, ``CI::tmu``, ``CQR::gram``...) entered as `jax.named_scope`,
+   so every HLO op carries its phase in metadata and `jax.profiler` traces
+   (`trace`) decompose by phase in Perfetto/TensorBoard exactly like critter's
+   symbol decomposition.
+
+2. **An analytic cost model** (`Recorder` + ``*_cost``): at trace time, the
+   SUMMA layer and the algorithm base cases emit per-phase flop counts,
+   collective byte counts, and collective (synchronization) counts computed
+   from shapes and the grid — the alpha-beta model critter fits empirically
+   (cp-comp / cp-comm / cp-synch columns), derived analytically instead.
+   Tracing happens once per jit cache entry, so a Recorder activated around
+   the *first* call of a jitted function captures exactly one execution's
+   worth of costs.
+
+3. **Cost tables** (`write_times_table` / `write_costs_table`): fixed-width
+   text tables in the shape of the reference's autotune output
+   (autotune/util.h:4-127), consumed by capital_tpu/autotune.
+
+Device constants (`DeviceSpec`) are public-spec estimates used to convert the
+model's flops/bytes into seconds for the table's time columns; measured wall
+time always comes from `measure`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import statistics
+import time
+from collections import defaultdict
+from typing import Callable, Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+
+# --------------------------------------------------------------------------
+# device specs (public numbers; estimates for the model's time conversion)
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceSpec:
+    """Per-chip hardware model: peak matmul throughput + interconnect/memory
+    bandwidth.  The analog of the alpha-beta machine parameters critter fits."""
+
+    name: str
+    peak_bf16_tflops: float
+    hbm_gbps: float
+    ici_gbps: float  # per-direction aggregate ICI bandwidth per chip
+
+    def peak_tflops(self, dtype) -> float:
+        if jnp.dtype(dtype).itemsize >= 4:
+            return self.peak_bf16_tflops / 2.0
+        return self.peak_bf16_tflops
+
+
+_SPECS = (
+    DeviceSpec("v6e", 918.0, 1640.0, 448.0),
+    DeviceSpec("v6", 918.0, 1640.0, 448.0),
+    DeviceSpec("v5p", 459.0, 2765.0, 600.0),
+    DeviceSpec("v5", 197.0, 819.0, 400.0),
+    DeviceSpec("lite", 197.0, 819.0, 400.0),
+    DeviceSpec("v4", 275.0, 1228.0, 300.0),
+    DeviceSpec("v3", 123.0, 900.0, 200.0),
+    DeviceSpec("cpu", 0.2, 50.0, 10.0),  # virtual-device test rig
+)
+_DEFAULT = DeviceSpec("unknown", 197.0, 819.0, 400.0)
+
+
+def device_spec(device: Optional[jax.Device] = None) -> DeviceSpec:
+    device = device or jax.devices()[0]
+    kind = getattr(device, "device_kind", device.platform).lower()
+    for s in _SPECS:
+        if s.name in kind:
+            return s
+    return _DEFAULT
+
+
+# --------------------------------------------------------------------------
+# phase scopes + recorder
+# --------------------------------------------------------------------------
+
+_SCOPE_STACK: list[str] = []
+_ACTIVE: list["Recorder"] = []
+
+
+@dataclasses.dataclass
+class PhaseStats:
+    """Accumulated model costs for one phase tag (one critter symbol)."""
+
+    calls: int = 0
+    flops: float = 0.0  # dense flops actually executed, per device
+    comm_bytes: float = 0.0  # collective bytes moved, per device
+    collectives: int = 0  # collective count (synchronization/latency terms)
+
+    def merge(self, other: "PhaseStats") -> None:
+        self.calls += other.calls
+        self.flops += other.flops
+        self.comm_bytes += other.comm_bytes
+        self.collectives += other.collectives
+
+
+@contextlib.contextmanager
+def scope(tag: str):
+    """Enter an algorithm phase: named XLA scope + cost-model attribution.
+
+    Tags follow the reference's symbol names (``CI::trsm``, ``CQR::gram``,
+    cholinv.hpp:94-136, cacqr.hpp:82-116).
+    """
+    _SCOPE_STACK.append(tag)
+    try:
+        with jax.named_scope(tag.replace("::", ".")):
+            yield
+    finally:
+        _SCOPE_STACK.pop()
+
+
+def current_scope() -> str:
+    return "/".join(_SCOPE_STACK) if _SCOPE_STACK else "<top>"
+
+
+def emit(flops: float = 0.0, comm_bytes: float = 0.0, collectives: int = 0) -> None:
+    """Attribute model costs to the innermost active phase.
+
+    Called by the SUMMA layer and algorithm base cases at trace time; no-op
+    unless a Recorder is active (zero overhead in production paths)."""
+    if not _ACTIVE:
+        return
+    tag = _SCOPE_STACK[-1] if _SCOPE_STACK else "<top>"
+    for rec in _ACTIVE:
+        st = rec.stats[tag]
+        st.calls += 1
+        st.flops += flops
+        st.comm_bytes += comm_bytes
+        st.collectives += collectives
+
+
+class Recorder:
+    """Collects per-phase model costs during one tracing pass.
+
+    Usage::
+
+        with tracing.Recorder() as rec:
+            jitted(args)          # first call: traces, recorder captures
+        rec.total().flops, rec.stats['CI::trsm'].comm_bytes, ...
+
+    The reference's equivalent is critter's start/stop + get_*_costs
+    (tune.cpp:61-82)."""
+
+    def __init__(self) -> None:
+        self.stats: dict[str, PhaseStats] = defaultdict(PhaseStats)
+
+    def __enter__(self) -> "Recorder":
+        _ACTIVE.append(self)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        _ACTIVE.remove(self)
+
+    def total(self) -> PhaseStats:
+        t = PhaseStats()
+        for s in self.stats.values():
+            t.merge(s)
+        return t
+
+    def estimate_seconds(
+        self, spec: Optional[DeviceSpec] = None, dtype=jnp.float32, efficiency: float = 0.6
+    ) -> dict[str, tuple[float, float]]:
+        """Per-phase (comp_s, comm_s) estimates from the device model.
+
+        efficiency derates peak matmul throughput (achievable fraction)."""
+        spec = spec or device_spec()
+        peak = spec.peak_tflops(dtype) * 1e12 * efficiency
+        out = {}
+        for tag, s in self.stats.items():
+            out[tag] = (s.flops / peak, s.comm_bytes / (spec.ici_gbps * 1e9))
+        return out
+
+
+# --------------------------------------------------------------------------
+# analytic collective/compute cost helpers (the alpha-beta model)
+# --------------------------------------------------------------------------
+
+
+def _ring_bytes(block_bytes: float, p: int) -> float:
+    """Bytes per device for a ring broadcast/allgather of `block_bytes` over
+    an axis of p devices: (p-1)/p * total."""
+    return block_bytes * (p - 1) / p if p > 1 else 0.0
+
+
+def _allreduce_bytes(block_bytes: float, p: int) -> float:
+    """Ring allreduce: 2(p-1)/p * bytes (reduce-scatter + allgather)."""
+    return 2.0 * block_bytes * (p - 1) / p if p > 1 else 0.0
+
+
+def gemm_cost(grid, M: int, N: int, K: int, dtype) -> tuple[float, float, int]:
+    """(flops, comm_bytes, collectives) per device for a distributed matmul
+    C[M,N] = A[M,K] @ B[K,N] under the SUMMA schedule on a dx x dy x c grid.
+
+    Models the explicit schedule (parallel/summa.py:_explicit_matmul; the
+    reference's summa.hpp:177-249): per K-step a row-axis bcast of an A block
+    and a column-axis bcast of a B block, d/c steps per depth layer, one
+    allreduce of the C block over depth.  The 'xla' mode compiles to a
+    schedule of the same family, so the model serves both.
+    """
+    dx, dy, c = grid.dx, grid.dy, grid.c
+    item = jnp.dtype(dtype).itemsize
+    p = dx * dy * c
+    flops = 2.0 * M * N * K / p
+    d = max(dx, dy)
+    steps = max(1, d // max(c, 1))
+    a_blk = (M / dx) * (K / d) * item
+    b_blk = (K / d) * (N / dy) * item
+    c_blk = (M / dx) * (N / dy) * item
+    comm = steps * (_ring_bytes(a_blk, dy) + _ring_bytes(b_blk, dx))
+    comm += _allreduce_bytes(c_blk, c)
+    ncoll = (2 * steps if (dx > 1 or dy > 1) else 0) + (1 if c > 1 else 0)
+    return flops, comm, ncoll
+
+
+def replicate_cost(grid, m: int, n: int, dtype) -> tuple[float, int]:
+    """(comm_bytes, collectives) to replicate an m x n panel to every device
+    (all_gather over the whole mesh) — the base-case gather, the analog of
+    MPI_Allgather over the slice communicator (cholinv policy.h:176)."""
+    p = grid.num_devices
+    bytes_total = m * n * jnp.dtype(dtype).itemsize
+    return (_ring_bytes(bytes_total, p), 1 if p > 1 else 0)
+
+
+def allreduce_cost(grid, m: int, n: int, dtype, axes: str = "all") -> tuple[float, int]:
+    """(comm_bytes, collectives) for psum of an m x n value.
+
+    axes='all' reduces over the whole mesh (the 1D gram allreduce,
+    cacqr.hpp:22); axes='z' over depth only (SUMMA collect, summa.hpp:236)."""
+    p = grid.num_devices if axes == "all" else grid.c
+    return (_allreduce_bytes(m * n * jnp.dtype(dtype).itemsize, p), 1 if p > 1 else 0)
+
+
+def potrf_trtri_flops(n: int) -> float:
+    """Local panel factor + triangular inverse: n³/3 + n³/3."""
+    return 2.0 * n**3 / 3.0
+
+
+# --------------------------------------------------------------------------
+# measurement (wall clock) + profiler integration
+# --------------------------------------------------------------------------
+
+
+def measure(
+    fn: Callable,
+    *args,
+    iters: int = 3,
+    repeats: int = 3,
+    warmup: bool = True,
+) -> float:
+    """Median wall seconds per call of `fn(*args)`, properly synced.
+
+    The reference's timing discipline is barrier + MPI_Wtime around the
+    factor call with a warmup iteration (bench/cholesky/cholinv.cpp:44-59);
+    the TPU equivalent must defeat async dispatch: block_until_ready on the
+    result is the sync point.
+    """
+    if warmup:
+        jax.block_until_ready(fn(*args))
+    walls = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        walls.append((time.perf_counter() - t0) / iters)
+    return statistics.median(walls)
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """jax.profiler trace around a region — the deep-inspection path
+    (critter's set_mechanism analog; view in TensorBoard/Perfetto, phases
+    appear under the named scopes)."""
+    with jax.profiler.trace(logdir):
+        yield
+
+
+# --------------------------------------------------------------------------
+# cost tables (reference autotune/util.h format family)
+# --------------------------------------------------------------------------
+
+_W = 15
+
+
+def _row(cells: Iterable) -> str:
+    return "".join(f"{str(c):<{_W}}" for c in cells) + "\n"
+
+
+def write_times_table(
+    path: str,
+    rows: list[tuple[str, float, dict[str, tuple[float, float]]]],
+) -> None:
+    """Measured + estimated per-phase times, one row per config.
+
+    rows: (config_id, measured_wall_s, {tag: (est_comp_s, est_comm_s)}).
+    Mirrors the *_cp_times tables (autotune/util.h:4-20): Raw = measured
+    wall; per-tag comp/comm estimate columns.
+    """
+    tags = sorted({t for _, _, est in rows for t in est})
+    with open(path, "w") as f:
+        f.write(_row(["Config", "Raw"] + [f"{t}-comp" for t in tags] + [f"{t}-comm" for t in tags]))
+        for cid, wall, est in rows:
+            f.write(
+                _row(
+                    [cid, f"{wall:.6f}"]
+                    + [f"{est.get(t, (0, 0))[0]:.6f}" for t in tags]
+                    + [f"{est.get(t, (0, 0))[1]:.6f}" for t in tags]
+                )
+            )
+
+
+def write_costs_table(path: str, rows: list[tuple[str, Recorder]]) -> None:
+    """Model cost decomposition per config: flops / comm bytes / collective
+    count per phase — the *_cp_costs analog (autotune/util.h:21-29):
+    comp ↔ Decomp-comp, comm bytes ↔ Decomp-BSPcomm, collectives ↔ synch."""
+    tags = sorted({t for _, rec in rows for t in rec.stats})
+    with open(path, "w") as f:
+        f.write(
+            _row(
+                ["Config"]
+                + [f"{t}-comp" for t in tags]
+                + [f"{t}-comm" for t in tags]
+                + [f"{t}-synch" for t in tags]
+            )
+        )
+        for cid, rec in rows:
+            f.write(
+                _row(
+                    [cid]
+                    + [f"{rec.stats[t].flops:.3e}" if t in rec.stats else "0" for t in tags]
+                    + [f"{rec.stats[t].comm_bytes:.3e}" if t in rec.stats else "0" for t in tags]
+                    + [str(rec.stats[t].collectives) if t in rec.stats else "0" for t in tags]
+                )
+            )

@@ -71,10 +71,12 @@ def main() -> None:
     dev = jax.devices()[0]
     grid = Grid.square(c=1, devices=[dev])
 
-    # bf16 throughput config: trailing updates at the MXU's native precision,
-    # base case in f32 (CholinvConfig default picks f32 for narrow inputs)
+    # bf16 throughput config: trailing updates at the MXU's native precision
+    # through the pallas dead-block-skipping kernels, base case in f32
+    # (CholinvConfig default picks f32 for narrow inputs)
     cfg = cholesky.CholinvConfig(
-        base_case_dim=2048,
+        base_case_dim=512,
+        mode="pallas",
         precision=None if jnp.dtype(dtype).itemsize < 4 else "highest",
     )
 
@@ -92,19 +94,24 @@ def main() -> None:
     del M
 
     @jax.jit
-    def loop(a, iters):
+    def loop(a, eps, iters):
         def body(_, carry):
             R, Rinv = cholesky.factor(grid, carry, cfg)
-            # data-dependent carry: perturb below dtype resolution so no
-            # iteration can be folded away, while staying numerically inert
-            return carry + jnp.asarray(1e-30, carry.dtype) * R
+            # data-dependent carry consuming BOTH outputs: eps is a runtime
+            # scalar (0.0 at call time) so XLA cannot fold the perturbation
+            # away and dead-code-eliminate the factorization — slicing the
+            # carry or consuming only R lets the whole Rinv computation (half
+            # the useful flops) be DCE'd and inflates the number.
+            return carry + eps.astype(carry.dtype) * (R + Rinv)
 
         out = jax.lax.fori_loop(0, iters, body, a)
-        return jnp.sum(out[:1, :1])
+        return jnp.sum(out, dtype=jnp.float32)
+
+    eps = jnp.asarray(0.0, jnp.float32)
 
     def timed(k: int) -> float:
         t0 = time.perf_counter()
-        float(loop(A, k))  # host transfer = real sync
+        float(loop(A, eps, k))  # host transfer = real sync
         return time.perf_counter() - t0
 
     timed(1)  # warmup: compile (dynamic trip count -> one executable)

@@ -47,7 +47,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from capital_tpu.ops import lapack
+from capital_tpu.ops import lapack, masking
 from capital_tpu.parallel import summa
 from capital_tpu.parallel.summa import SyrkArgs, TrmmArgs
 from capital_tpu.parallel.topology import Grid
@@ -70,7 +70,9 @@ class CholinvConfig:
         the reference's sign/multiplier encoding (bc_mult_dim) with the size
         itself.
     policy: base-case replication strategy (see BaseCasePolicy).
-    mode: SUMMA execution mode for the trmm/syrk phases ('xla'|'explicit').
+    mode: SUMMA execution mode for the trmm/syrk phases
+        ('xla'|'explicit'|'pallas' — 'pallas' skips dead triangular blocks
+        on the MXU for single-device grids, parallel/summa.py).
     base_case_dtype: dtype for the base-case potrf+trtri; None means f32
         when the input is narrower than f32, else the input dtype.
     """
@@ -167,7 +169,11 @@ def _base_case(
         tracing.emit(
             flops=tracing.potrf_trtri_flops(n), comm_bytes=comm, collectives=ncoll
         )
-        panel = A.astype(bc_dtype)
+        # Rebuild the full symmetric panel from its upper triangle: Schur
+        # windows arriving from mode='pallas' syrk carry only the upper half
+        # (summa.syrk uplo semantics); for dense-symmetric windows this is a
+        # no-op-equivalent elementwise pass.
+        panel = masking.symmetrize_from(A.astype(bc_dtype), "U")
         if not cfg.policy.single_device_compute:
             panel = lax.with_sharding_constraint(panel, grid.replicated_sharding())
         R, Rinv = lapack.potrf_trtri(panel, uplo="U")

@@ -42,7 +42,7 @@ import dataclasses
 import statistics
 import time
 from collections import defaultdict
-from typing import Callable, Iterable, Optional
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -127,10 +127,6 @@ def scope(tag: str):
             yield
     finally:
         _SCOPE_STACK.pop()
-
-
-def current_scope() -> str:
-    return "/".join(_SCOPE_STACK) if _SCOPE_STACK else "<top>"
 
 
 def emit(flops: float = 0.0, comm_bytes: float = 0.0, collectives: int = 0) -> None:
@@ -299,11 +295,13 @@ def trace(logdir: str):
 # cost tables (reference autotune/util.h format family)
 # --------------------------------------------------------------------------
 
-_W = 15
-
-
-def _row(cells: Iterable) -> str:
-    return "".join(f"{str(c):<{_W}}" for c in cells) + "\n"
+def _rows_to_text(rows: list[list]) -> str:
+    """Fixed-width table: column width = longest cell + 2 (the reference
+    hardcodes setw(15), which its short numeric configs fit; phase-tag
+    columns here are longer, so size to content to keep columns aligned)."""
+    cells = [[str(c) for c in r] for r in rows]
+    width = max((len(c) for r in cells for c in r), default=0) + 2
+    return "".join("".join(f"{c:<{width}}" for c in r) + "\n" for r in cells)
 
 
 def write_times_table(
@@ -317,16 +315,15 @@ def write_times_table(
     wall; per-tag comp/comm estimate columns.
     """
     tags = sorted({t for _, _, est in rows for t in est})
+    table = [["Config", "Raw"] + [f"{t}-comp" for t in tags] + [f"{t}-comm" for t in tags]]
+    for cid, wall, est in rows:
+        table.append(
+            [cid, f"{wall:.6f}"]
+            + [f"{est.get(t, (0, 0))[0]:.6f}" for t in tags]
+            + [f"{est.get(t, (0, 0))[1]:.6f}" for t in tags]
+        )
     with open(path, "w") as f:
-        f.write(_row(["Config", "Raw"] + [f"{t}-comp" for t in tags] + [f"{t}-comm" for t in tags]))
-        for cid, wall, est in rows:
-            f.write(
-                _row(
-                    [cid, f"{wall:.6f}"]
-                    + [f"{est.get(t, (0, 0))[0]:.6f}" for t in tags]
-                    + [f"{est.get(t, (0, 0))[1]:.6f}" for t in tags]
-                )
-            )
+        f.write(_rows_to_text(table))
 
 
 def write_costs_table(path: str, rows: list[tuple[str, Recorder]]) -> None:
@@ -334,21 +331,18 @@ def write_costs_table(path: str, rows: list[tuple[str, Recorder]]) -> None:
     count per phase — the *_cp_costs analog (autotune/util.h:21-29):
     comp ↔ Decomp-comp, comm bytes ↔ Decomp-BSPcomm, collectives ↔ synch."""
     tags = sorted({t for _, rec in rows for t in rec.stats})
-    with open(path, "w") as f:
-        f.write(
-            _row(
-                ["Config"]
-                + [f"{t}-comp" for t in tags]
-                + [f"{t}-comm" for t in tags]
-                + [f"{t}-synch" for t in tags]
-            )
+    table = [
+        ["Config"]
+        + [f"{t}-comp" for t in tags]
+        + [f"{t}-comm" for t in tags]
+        + [f"{t}-synch" for t in tags]
+    ]
+    for cid, rec in rows:
+        table.append(
+            [cid]
+            + [f"{rec.stats[t].flops:.3e}" if t in rec.stats else "0" for t in tags]
+            + [f"{rec.stats[t].comm_bytes:.3e}" if t in rec.stats else "0" for t in tags]
+            + [str(rec.stats[t].collectives) if t in rec.stats else "0" for t in tags]
         )
-        for cid, rec in rows:
-            f.write(
-                _row(
-                    [cid]
-                    + [f"{rec.stats[t].flops:.3e}" if t in rec.stats else "0" for t in tags]
-                    + [f"{rec.stats[t].comm_bytes:.3e}" if t in rec.stats else "0" for t in tags]
-                    + [str(rec.stats[t].collectives) if t in rec.stats else "0" for t in tags]
-                )
-            )
+    with open(path, "w") as f:
+        f.write(_rows_to_text(table))

@@ -1,0 +1,132 @@
+"""Triangular-predicated Pallas kernel tests (interpret mode on the CPU rig).
+
+Checks every structure-flag combination of ops/pallas_tpu.tri_matmul against
+dense masked references, odd (non-tile-aligned) shapes, and the summa-layer
+pallas mode end to end through cholinv (the consumer whose Schur windows
+carry upper-triangle-only data)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from capital_tpu.models import cholesky
+from capital_tpu.ops.pallas_tpu import default_blocks, tri_matmul
+from capital_tpu.parallel import summa
+from capital_tpu.parallel.topology import Grid
+from capital_tpu.utils import rand48, residual
+
+
+@pytest.fixture(scope="module")
+def grid1():
+    return Grid.square(c=1, devices=jax.devices("cpu")[:1])
+
+
+@pytest.fixture(scope="module")
+def mats():
+    rng = np.random.default_rng(0)
+    n, m = 300, 200  # deliberately not multiples of 128
+    A = jnp.asarray(rng.standard_normal((n, n)))
+    B = jnp.asarray(rng.standard_normal((n, m)))
+    C = jnp.asarray(rng.standard_normal((m, n)))
+    return A, B, C
+
+
+def _close(got, want, tol=1e-10):
+    assert float(jnp.max(jnp.abs(got - want))) < tol
+
+
+def test_plain_matmul(mats):
+    A, B, _ = mats
+    _close(tri_matmul(A, B), A @ B)
+
+
+@pytest.mark.parametrize("uplo", ["U", "L"])
+@pytest.mark.parametrize("trans", [False, True])
+def test_a_triangular(mats, uplo, trans):
+    A, B, _ = mats
+    T = jnp.triu(A) if uplo == "U" else jnp.tril(A)
+    Top = T.T if trans else T
+    _close(tri_matmul(A, B, a_uplo=uplo, a_trans=trans), Top @ B)
+
+
+@pytest.mark.parametrize("uplo", ["U", "L"])
+@pytest.mark.parametrize("trans", [False, True])
+def test_b_triangular(mats, uplo, trans):
+    A, _, C = mats
+    T = jnp.triu(A) if uplo == "U" else jnp.tril(A)
+    Top = T.T if trans else T
+    _close(tri_matmul(C, A, b_uplo=uplo, b_trans=trans), C @ Top)
+
+
+@pytest.mark.parametrize("uplo", ["U", "L"])
+def test_syrk_out_triangle(mats, uplo):
+    _, B, _ = mats
+    full = B.T @ B
+    want = jnp.triu(full) if uplo == "U" else jnp.tril(full)
+    _close(tri_matmul(B, B, a_trans=True, out_uplo=uplo), want, tol=1e-9)
+
+
+def test_alpha_and_explicit_blocks(mats):
+    A, B, _ = mats
+    _close(
+        tri_matmul(A, B, a_uplo="U", alpha=-2.0, blocks=(128, 128, 128)),
+        -2.0 * jnp.triu(A) @ B,
+    )
+
+
+def test_dead_triangle_ignored(mats):
+    """Entries in the dead triangle must be treated as zero regardless of
+    buffer contents (BLAS trmm contract)."""
+    A, B, _ = mats
+    garbage = A + jnp.tril(jnp.full_like(A, 1e6), k=-1)
+    _close(tri_matmul(garbage, B, a_uplo="U"), jnp.triu(A) @ B)
+
+
+def test_flag_validation(mats):
+    A, B, _ = mats
+    with pytest.raises(ValueError, match="at most one"):
+        tri_matmul(A, A, a_uplo="U", b_uplo="L")
+    with pytest.raises(ValueError, match="out_uplo"):
+        tri_matmul(A, A, a_uplo="U", out_uplo="U")
+    with pytest.raises(ValueError, match="mismatch"):
+        tri_matmul(A, B.T)
+
+
+def test_default_blocks_budget():
+    bm, bn, bk = default_blocks(8192, 8192, 8192, itemsize=2)
+    assert (bm, bn, bk) == (512, 512, 2048)
+    assert default_blocks(8192, 8192, 8192, itemsize=4)[2] == 1024
+    # small operands shrink to their padded size
+    assert default_blocks(100, 100, 100) == (128, 128, 128)
+
+
+def test_summa_trmm_pallas_mode(grid1, mats):
+    A, B, _ = mats
+    out = summa.trmm(
+        grid1, A, B, summa.TrmmArgs(side="L", uplo="U", trans_a=True),
+        mode="pallas",
+    )
+    _close(out, jnp.triu(A).T @ B)
+
+
+def test_summa_syrk_pallas_mode_keeps_beta_dense(grid1, mats):
+    A, B, _ = mats
+    C0 = jnp.asarray(np.random.default_rng(1).standard_normal((B.shape[1],) * 2))
+    out = summa.syrk(
+        grid1, B, C0, summa.SyrkArgs(trans=True, alpha=-1.0, beta=1.0),
+        mode="pallas",
+    )
+    want_upper = jnp.triu(-(B.T @ B)) + C0
+    # live triangle: product + beta*C; dead half: beta*C only
+    _close(jnp.triu(out), jnp.triu(want_upper), tol=1e-9)
+    _close(jnp.tril(out, k=-1), jnp.tril(C0, k=-1))
+
+
+def test_cholinv_pallas_mode_end_to_end(grid1):
+    n = 192
+    A = jnp.asarray(rand48.symmetric(n))
+    cfg = cholesky.CholinvConfig(base_case_dim=64, mode="pallas")
+    R, Rinv = jax.jit(lambda a: cholesky.factor(grid1, a, cfg))(A)
+    assert float(residual.cholesky_residual(A, R)) < 1e-13
+    assert float(residual.cholesky_inverse_residual(R, Rinv)) < 1e-13

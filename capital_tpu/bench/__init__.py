@@ -1,0 +1,1 @@
+"""Benchmark drivers (reference bench/) — see drivers.py and suite.py."""

@@ -1,0 +1,3 @@
+from capital_tpu.bench.drivers import main
+
+main()

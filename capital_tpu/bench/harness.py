@@ -1,0 +1,101 @@
+"""Shared benchmark harness: timing discipline + result reporting.
+
+The reference's drivers (bench/*/*.cpp) share a fixed shape: parse argv,
+build a topology, distribute a matrix, warm up, run timed iterations under
+`MPI_Barrier; MPI_Wtime`, and print the max-over-ranks wall time
+(bench/cholesky/cholinv.cpp:44-59).  On TPU the same discipline needs two
+changes:
+
+* async dispatch means host-side walls lie — so the iteration loop runs
+  INSIDE one jit (`lax.fori_loop` with a data-dependent carry that consumes
+  every algorithm output, preventing dead-code elimination of the work), and
+  the per-iteration time is the delta between an (iters+1)-iteration run and
+  a 1-iteration run, which also cancels the fixed dispatch/tunnel overhead;
+* "max over ranks" is automatic — one XLA program spans the mesh, so the
+  wall covers the slowest chip.
+
+Each driver prints ONE JSON line: {"metric", "value", "unit",
+"vs_baseline", ...context}.  `vs_baseline` is achieved/target where the
+target is 90% of the chip's peak dense-matmul throughput at the bench dtype
+(BASELINE.md: the reference publishes no absolute numbers, so the
+peak-relative north star *is* the baseline).
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import time
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from capital_tpu.utils import tracing
+
+
+def peak_tflops(device=None, dtype=jnp.bfloat16) -> float:
+    """Peak dense-matmul TFLOP/s for one chip at `dtype` (public specs)."""
+    return tracing.device_spec(device).peak_tflops(dtype)
+
+
+def timed_loop(
+    step: Callable[[jnp.ndarray], jnp.ndarray],
+    operand: jnp.ndarray,
+    iters: int = 3,
+    repeats: int = 3,
+) -> float:
+    """Median per-iteration seconds of `step`, run `iters` times inside jit.
+
+    `step(operand) -> array of operand's shape/dtype` must consume all the
+    outputs it wants timed (see module docstring on DCE).  The perturbation
+    scalar `eps` is 0.0 at call time but runtime-valued, so XLA cannot fold
+    the iteration chain away.
+    """
+
+    @jax.jit
+    def loop(a, eps, k):
+        def body(_, carry):
+            out = step(carry)
+            return carry + eps.astype(carry.dtype) * out
+
+        out = jax.lax.fori_loop(0, k, body, a)
+        return jnp.sum(out, dtype=jnp.float32)
+
+    eps = jnp.asarray(0.0, jnp.float32)
+
+    def run(k: int) -> float:
+        t0 = time.perf_counter()
+        float(loop(operand, eps, k))  # host transfer = real sync
+        return time.perf_counter() - t0
+
+    run(1)  # compile (dynamic trip count -> one executable reused for both k)
+    deltas = [run(iters + 1) - run(1) for _ in range(repeats)]
+    return statistics.median(deltas) / iters
+
+
+def report(
+    metric: str,
+    seconds: float,
+    flops: float,
+    dtype,
+    device=None,
+    **context,
+) -> dict:
+    """Print + return the one-line JSON record."""
+    device = device or jax.devices()[0]
+    tflops = flops / seconds / 1e12
+    target = 0.9 * peak_tflops(device, dtype)
+    rec = {
+        "metric": metric,
+        "value": round(tflops, 3),
+        "unit": "TFLOP/s",
+        "vs_baseline": round(tflops / target, 4),
+        "seconds": round(seconds, 5),
+        "dtype": str(jnp.dtype(dtype)),
+        "device": device.device_kind,
+        "target_tflops": round(target, 1),
+        **context,
+    }
+    print(json.dumps(rec))
+    return rec

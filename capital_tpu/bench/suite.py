@@ -1,0 +1,67 @@
+"""The BASELINE.json benchmark suite — the reference's de-facto config set.
+
+Five configs (BASELINE.md "Benchmark configurations"):
+  1. single-device blocked Cholesky, N=4096
+  2. single-device CQR2 tall-skinny QR, 65536 x 512
+  3. recursive comm-avoiding Cholesky on a 2x2 grid face, N=16384
+  4. CQR2 across 8 devices, tall-skinny 2M x 1024
+  5. SPD inverse via Cholesky (+ the autotune sweep lives in
+     capital_tpu.autotune, run separately)
+
+Multi-device configs run when the platform has enough devices (real chips,
+or a CPU mesh under --xla_force_host_platform_device_count); otherwise they
+fall back to all available devices and say so.  --scale divides the problem
+sizes for smoke runs on the test rig.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+
+
+def _args(base: argparse.Namespace, **over) -> argparse.Namespace:
+    ns = argparse.Namespace(**vars(base))
+    for k, v in over.items():
+        setattr(ns, k, v)
+    return ns
+
+
+def run(base: argparse.Namespace, scale: int = 1) -> list[dict]:
+    from capital_tpu.bench import drivers
+
+    scale = getattr(base, "scale", scale) or scale
+    ndev = len(jax.devices())
+    out = []
+
+    def go(name, fn, **over):
+        print(f"# suite: {name}")
+        out.append(fn(_args(base, **over)))
+
+    go("cholesky N=4096 single-device", drivers.cholinv,
+       n=max(256, 4096 // scale), devices=1)
+    go("cacqr2 65536x512 single-device", drivers.cacqr,
+       m=max(1024, 65536 // scale), n=max(64, 512 // scale), devices=1,
+       variant=2)
+    d4 = 4 if ndev >= 4 else 1
+    go(f"recursive cholesky N=16384 2x2 grid ({d4} devices)", drivers.cholinv,
+       n=max(512, 16384 // scale), devices=d4, c=1)
+    d8 = 8 if ndev >= 8 else ndev
+    go(f"cacqr2 2Mx1024 tree ({d8} devices)", drivers.cacqr,
+       m=max(2048, 2**21 // scale), n=max(128, 1024 // scale), devices=0,
+       variant=2)
+    go("spd inverse via cholesky", drivers.spd_inverse,
+       n=max(256, 4096 // scale))
+    return out
+
+
+def main(argv=None) -> None:
+    from capital_tpu.bench import drivers
+
+    args = drivers.build_parser().parse_args(argv or ["suite"])
+    run(args)
+
+
+if __name__ == "__main__":
+    main()

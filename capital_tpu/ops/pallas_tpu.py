@@ -90,7 +90,14 @@ def default_blocks(m: int, k: int, n: int, itemsize: int = 2) -> tuple[int, int,
     cap, _ = _device_budget()
     bm = max(128, min(cap, _round_up(m, 128)))
     bn = max(128, min(cap, _round_up(n, 128)))
-    bk_cap = cap if cap > 512 else (2048 if itemsize <= 2 else 1024)
+    dtype_bk = 2048 if itemsize <= 2 else 1024
+    if cap > 512 and bm >= cap and bn >= cap:
+        # large square tiles: the measured-optimal config is bk == cap
+        bk_cap = cap
+    else:
+        # skinny/deep-K shapes (e.g. gram contractions): small output tiles
+        # leave VMEM headroom, so amortize over a deeper K panel
+        bk_cap = max(cap, dtype_bk) if cap > 512 else dtype_bk
     bk = max(128, min(bk_cap, _round_up(k, 128)))
     return bm, bn, bk
 

@@ -1,0 +1,163 @@
+"""Layout engine: block/cyclic repack + packed-triangular storage.
+
+TPU-native re-design of two reference components:
+
+* the ``serialize<S1,S2>`` structure-to-structure copy engine
+  (src/matrix/serialize.h:16-70) — here packed-triangular <-> dense
+  conversions on whole arrays (the reference's 7 pairwise specializations
+  collapse to pack/unpack through the dense form, since dense tiles are the
+  native TPU representation and packed storage only appears at the
+  host/serialization boundary);
+* the ``util::block_to_cyclic_* / cyclic_to_block_*`` repack kernels
+  (src/util/util.hpp:56-230) that sit between the block distribution and the
+  element-cyclic layout the reference's base-case LAPACK calls expect.
+
+The reference implements these as scalar index loops (the "hot repack loop"
+on its profile, SURVEY §3.1); here they are O(1) reshape/transpose
+compositions that XLA lowers to a single copy — and, because the TPU
+framework keeps matrices **block**-distributed everywhere (topology.py
+docstring), they are needed only for parity testing against reference
+layouts and for import/export of reference-ordered data, never on the
+compute path.
+
+Array-API note: functions accept numpy or jax arrays and return the same
+family (repacks are pure reshapes; `xp` is chosen from the input).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+
+def _xp(A):
+    return np if isinstance(A, np.ndarray) else jnp
+
+
+def get_next_power2(n: int) -> int:
+    """Smallest power of two >= n (reference util.hpp:249-264, bit-twiddle)."""
+    if n <= 1:
+        return 1
+    return 1 << (int(n - 1).bit_length())
+
+
+# --------------------------------------------------------------------------
+# block <-> cyclic global reorderings
+# --------------------------------------------------------------------------
+#
+# Conventions, matching the reference (structure.hpp / matrix.hpp:6-18):
+#   * "block" layout: the global (M, N) matrix is a (dx, dy) grid of
+#     contiguous (M/dx, N/dy) local tiles; rank (x, y) owns tile (x, y).
+#   * "cyclic" layout: rank (x, y) owns global elements (i, j) with
+#     i % dx == x, j % dy == y — i.e. local element (k, l) is global
+#     (k*dx + x, l*dy + y).
+# The repack maps a matrix whose *storage order* is one layout's
+# gather-concatenation into the other's.  Gathering block-distributed tiles
+# over the slice produces storage [x][y][k][l] (tile-major); the cyclic view
+# of the same global matrix reads element (i, j) = (k*dx+x, l*dy+y).
+
+
+def block_to_cyclic(G: "np.ndarray", dx: int, dy: int):
+    """Reorder a block-gathered matrix into the true global (cyclic-read)
+    element order.
+
+    `G` is (dx*m, dy*n), laid out as dx x dy contiguous tiles where tile
+    (x, y) holds the elements rank (x, y) owns under the CYCLIC distribution.
+    Returns the (dx*m, dy*n) matrix in natural global order — the repack the
+    reference's base case performs before calling LAPACK
+    (util.hpp:99-133, block_to_cyclic_rect).
+    """
+    M, N = G.shape
+    m, n = M // dx, N // dy
+    T = G.reshape(dx, m, dy, n)  # [x][k][y][l]
+    # global (i, j) = (k*dx + x, l*dy + y)  ->  order axes as [k][x][l][y]
+    return T.transpose(1, 0, 3, 2).reshape(M, N)
+
+
+def cyclic_to_block(G: "np.ndarray", dx: int, dy: int):
+    """Inverse of :func:`block_to_cyclic` (reference cyclic_to_block_rect /
+    cyclic_to_local, util.hpp:135-230): slice a natural-order global matrix
+    into each rank's cyclic locals, concatenated tile-major."""
+    M, N = G.shape
+    m, n = M // dx, N // dy
+    T = G.reshape(m, dx, n, dy)  # [k][x][l][y]
+    return T.transpose(1, 0, 3, 2).reshape(M, N)
+
+
+def local_cyclic_tile(G: "np.ndarray", dx: int, dy: int, x: int, y: int):
+    """Rank (x, y)'s local shard under the cyclic distribution — global
+    elements (k*dx + x, l*dy + y) (reference structure.hpp distribution
+    arithmetic)."""
+    return G[x::dx, y::dy]
+
+
+def local_block_tile(G: "np.ndarray", dx: int, dy: int, x: int, y: int):
+    """Rank (x, y)'s local shard under the block distribution (this
+    framework's native layout, topology.py face_sharding)."""
+    M, N = G.shape
+    m, n = M // dx, N // dy
+    return G[x * m : (x + 1) * m, y * n : (y + 1) * n]
+
+
+# --------------------------------------------------------------------------
+# packed triangular storage (reference structure policies, structure.h:37-72)
+# --------------------------------------------------------------------------
+
+
+def pack_upper(A):
+    """Dense (n, n) -> column-packed upper triangle, length n(n+1)/2.
+
+    Matches the reference's `uppertri` storage: column j contributes its
+    j+1 leading entries, columns concatenated (structure.h:37-39: offset of
+    column x is x(x+1)/2)."""
+    xp = _xp(A)
+    n = A.shape[0]
+    # A.T[tril] walks (col, row<=col) pairs in column-major packed order
+    return A.T[xp.tril_indices(n)]
+
+
+def unpack_upper(packed, n: int):
+    """Column-packed upper triangle -> dense (n, n) with zero lower half."""
+    xp = _xp(packed)
+    out_t = xp.zeros((n, n), dtype=packed.dtype)
+    idx = xp.tril_indices(n)
+    if isinstance(packed, np.ndarray):
+        out_t[idx] = packed
+        return out_t.T
+    return out_t.at[idx].set(packed).T
+
+
+def pack_lower(A):
+    """Dense (n, n) -> column-packed lower triangle (reference `lowertri`,
+    structure.h:57-59: column x holds its n-x trailing entries)."""
+    xp = _xp(A)
+    n = A.shape[0]
+    return A.T[xp.triu_indices(n)]
+
+
+def unpack_lower(packed, n: int):
+    xp = _xp(packed)
+    out_t = xp.zeros((n, n), dtype=packed.dtype)
+    idx = xp.triu_indices(n)
+    if isinstance(packed, np.ndarray):
+        out_t[idx] = packed
+        return out_t.T
+    return out_t.at[idx].set(packed).T
+
+
+def num_packed_elems(n: int) -> int:
+    """n(n+1)/2 (reference structure.h:38, _num_elems)."""
+    return n * (n + 1) // 2
+
+
+def remove_triangle(A, uplo: str):
+    """Zero the *dead* half of a triangular matrix, keeping `uplo`
+    (reference util::remove_triangle[_local], util.hpp:266-318 — used before
+    validation gemms so stale scratch in the dead half cannot pollute
+    residuals)."""
+    xp = _xp(A)
+    n = A.shape[0]
+    i = xp.arange(A.shape[0])[:, None]
+    j = xp.arange(A.shape[1])[None, :]
+    keep = (i <= j) if uplo == "U" else (i >= j)
+    return xp.where(keep, A, xp.zeros((), dtype=A.dtype))

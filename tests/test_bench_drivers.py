@@ -1,0 +1,36 @@
+"""Bench-driver smoke tests: every driver runs + validates at tiny sizes.
+
+The reference's drivers ARE its integration tests (validation blocks in
+bench/*/*.cpp, SURVEY §4); here they run under pytest on the virtual CPU
+mesh so the whole driver surface stays green.
+"""
+
+import pytest
+
+from capital_tpu.bench import drivers
+
+
+def _run(argv):
+    drivers.main(argv)
+
+
+@pytest.mark.parametrize(
+    "argv",
+    [
+        ["cholinv", "--n", "192", "--bc", "64", "--devices", "1"],
+        ["cholinv", "--n", "128", "--bc", "32", "--c", "2", "--no-complete-inv"],
+        ["cacqr", "--m", "1024", "--n", "32", "--variant", "2"],
+        ["cacqr", "--m", "512", "--n", "16", "--variant", "1", "--devices", "1"],
+        ["summa_gemm", "--m", "128", "--n", "128", "--k", "128", "--c", "2"],
+        ["rectri", "--n", "128", "--bc", "32", "--devices", "1"],
+        ["newton", "--n", "96", "--newton-iters", "25", "--devices", "1"],
+        ["spd_inverse", "--n", "128", "--bc", "32", "--devices", "4"],
+    ],
+    ids=lambda a: "-".join(a[:1] + [x for x in a[1:] if not x.startswith("-")]),
+)
+def test_driver(argv):
+    _run(argv + ["--dtype", "float32", "--iters", "1", "--validate"])
+
+
+def test_suite_scaled():
+    _run(["suite", "--dtype", "float32", "--iters", "1", "--scale", "64", "--validate"])

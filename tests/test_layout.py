@@ -1,0 +1,83 @@
+"""Layout engine tests: block/cyclic repack round-trips and packed storage.
+
+Covers the TPU equivalents of the reference's serialize engine
+(serialize.h:16-70) and block<->cyclic repack kernels (util.hpp:56-230) —
+the property tests SURVEY §4 calls for.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from capital_tpu.utils import layout
+
+
+@pytest.mark.parametrize("dx,dy", [(1, 1), (2, 2), (2, 4), (3, 3)])
+def test_block_cyclic_roundtrip(dx, dy):
+    rng = np.random.default_rng(0)
+    G = rng.standard_normal((dx * 5, dy * 3))
+    assert np.array_equal(layout.cyclic_to_block(layout.block_to_cyclic(G, dx, dy), dx, dy), G)
+    assert np.array_equal(layout.block_to_cyclic(layout.cyclic_to_block(G, dx, dy), dx, dy), G)
+
+
+def test_block_to_cyclic_semantics():
+    """Tile (x, y) of the blocked buffer holds rank (x,y)'s cyclic elements:
+    local (k, l) = global (k*dx + x, l*dy + y) (reference structure.hpp
+    distribution arithmetic)."""
+    dx, dy, m, n = 2, 3, 4, 2
+    G = np.arange(dx * m * dy * n, dtype=np.float64).reshape(dx * m, dy * n)
+    blocked = layout.cyclic_to_block(G, dx, dy)
+    for x in range(dx):
+        for y in range(dy):
+            tile = blocked[x * m : (x + 1) * m, y * n : (y + 1) * n]
+            assert np.array_equal(tile, layout.local_cyclic_tile(G, dx, dy, x, y))
+
+
+def test_local_block_tile():
+    G = np.arange(36.0).reshape(6, 6)
+    t = layout.local_block_tile(G, 2, 3, 1, 2)
+    assert np.array_equal(t, G[3:6, 4:6])
+
+
+@pytest.mark.parametrize("n", [1, 4, 7])
+def test_pack_unpack_upper(n):
+    rng = np.random.default_rng(1)
+    A = np.triu(rng.standard_normal((n, n)))
+    p = layout.pack_upper(A)
+    assert p.shape == (layout.num_packed_elems(n),)
+    # reference structure.h:38: column x starts at offset x(x+1)/2 and holds
+    # its x+1 leading entries
+    for col in range(n):
+        off = col * (col + 1) // 2
+        assert np.array_equal(p[off : off + col + 1], A[: col + 1, col])
+    assert np.array_equal(layout.unpack_upper(p, n), A)
+
+
+@pytest.mark.parametrize("n", [1, 4, 7])
+def test_pack_unpack_lower(n):
+    rng = np.random.default_rng(2)
+    A = np.tril(rng.standard_normal((n, n)))
+    p = layout.pack_lower(A)
+    assert p.shape == (layout.num_packed_elems(n),)
+    assert np.array_equal(layout.unpack_lower(p, n), A)
+
+
+def test_pack_unpack_jax_arrays():
+    A = jnp.triu(jnp.arange(16.0).reshape(4, 4))
+    assert np.array_equal(layout.unpack_upper(layout.pack_upper(A), 4), np.asarray(A))
+    L = jnp.tril(jnp.arange(16.0).reshape(4, 4))
+    assert np.array_equal(layout.unpack_lower(layout.pack_lower(L), 4), np.asarray(L))
+
+
+def test_remove_triangle():
+    A = np.arange(1.0, 17.0).reshape(4, 4)
+    U = layout.remove_triangle(A, "U")
+    assert np.array_equal(U, np.triu(A))
+    L = layout.remove_triangle(jnp.asarray(A), "L")
+    assert np.array_equal(np.asarray(L), np.tril(A))
+
+
+def test_get_next_power2():
+    assert [layout.get_next_power2(k) for k in (1, 2, 3, 5, 8, 1000)] == [
+        1, 2, 4, 8, 8, 1024,
+    ]

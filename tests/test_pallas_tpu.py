@@ -99,6 +99,10 @@ def test_default_blocks_budget():
     cap, _ = _device_budget()  # 512 on the CPU rig, 1024 on v5e+
     bm, bn, bk = default_blocks(8192, 8192, 8192, itemsize=2)
     assert (bm, bn) == (cap, cap) and bk >= cap
+    # f32 halves the dtype K-budget on every chip class (VMEM headroom)
+    assert default_blocks(8192, 8192, 8192, itemsize=4)[2] <= 1024
+    # skinny output tiles afford a deep K panel regardless of chip cap
+    assert default_blocks(8192, 100000, 256, itemsize=2)[2] == 2048
     # small operands shrink to their padded size
     assert default_blocks(100, 100, 100) == (128, 128, 128)
     assert default_blocks(300, 8192, 8192)[0] == 384

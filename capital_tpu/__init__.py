@@ -27,6 +27,9 @@ Package layout:
                (reference src/util + test/ + critter shims)
   bench/     - benchmark drivers (reference bench/)
   autotune/  - config sweep harness (reference autotune/)
+  native/    - C++ host engine (ctypes): coordinate-seeded fillers, layout
+               repacks, and the alpha-beta schedule planner, with NumPy
+               fallbacks (the host-native remainder of the reference's C++)
 """
 
 __version__ = "0.1.0"

@@ -1,0 +1,9 @@
+"""Autotune subsystem (reference autotune/) — see sweep.py."""
+
+from capital_tpu.autotune.sweep import (  # noqa: F401
+    cacqr_space,
+    cholinv_space,
+    run_sweep,
+    tune_cacqr,
+    tune_cholinv,
+)

@@ -1,0 +1,66 @@
+"""CLI: python -m capital_tpu.autotune {cholinv,cacqr} [flags]."""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(prog="capital_tpu.autotune")
+    p.add_argument("alg", choices=["cholinv", "cacqr"])
+    p.add_argument("--n", type=int, default=4096)
+    p.add_argument("--m", type=int, default=65536)
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--out", default="autotune_out")
+    p.add_argument("--bc", type=int, nargs="+", default=None)
+    p.add_argument(
+        "--top-k", type=int, default=0,
+        help="cholinv: measure only the native planner's top-k model candidates",
+    )
+    p.add_argument("--devices", type=int, default=0)
+    p.add_argument("--platform", default=None)
+    p.add_argument("--host-devices", type=int, default=0)
+    args = p.parse_args(argv)
+
+    if args.host_devices:
+        import os
+
+        flags = [
+            f
+            for f in os.environ.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f
+        ]
+        flags.append(f"--xla_force_host_platform_device_count={args.host_devices}")
+        os.environ["XLA_FLAGS"] = " ".join(flags)
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    import jax.numpy as jnp
+
+    from capital_tpu.autotune import sweep
+    from capital_tpu.parallel.topology import Grid
+
+    dev = jax.devices()
+    if args.devices:
+        dev = dev[: args.devices]
+    dtype = jnp.dtype(args.dtype)
+    space = {"bc_dims": tuple(args.bc)} if args.bc else {}
+    if args.alg == "cholinv":
+        grid = Grid.square(c=1, devices=dev[:1]) if len(dev) == 1 else Grid.square(
+            c=1, devices=dev
+        )
+        res = sweep.tune_cholinv(
+            grid, args.n, dtype, args.out, prefilter_top_k=args.top_k, **space
+        )
+    else:
+        grid = Grid.flat(devices=dev)
+        res = sweep.tune_cacqr(grid, args.m, args.n if args.n < args.m else 512,
+                               dtype, args.out, **space)
+    best = res[0]
+    print(f"best: {best.config_id}  {best.seconds * 1e3:.3f} ms  -> {args.out}/")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,221 @@
+"""Autotune: config sweeps over algorithm knobs, with cost tables.
+
+The reference's autotune layer (autotune/{cholesky,qr}/*/tune.cpp +
+autotune/util.h) sweeps base-case policy x bcMultiplier (x grid shape for
+QR) under the critter measurement tool and writes critical-path cost tables
+(tune.cpp:175-253, autotune/util.h:4-127).  The TPU equivalent here:
+
+* the **measured** axis is wall time per factor call, taken with the in-jit
+  loop + delta discipline (bench/harness.py) — the reference's
+  barrier+MPI_Wtime with critter's timers;
+* the **modeled** axis is the alpha-beta cost decomposition captured by
+  tracing.Recorder at trace time (per-phase flops / comm bytes /
+  collective counts — critter's comp/comm/synch columns);
+* outputs: `<alg>_cp_times.txt` (measured + per-phase estimates) and
+  `<alg>_cp_costs.txt` (model decomposition), the *_cp_times/*_cp_costs
+  table family of autotune/util.h, plus `<alg>_best.json` with the winning
+  config — the piece the reference leaves to the user's eyeballs.
+
+Config spaces mirror tune.cpp: cholinv sweeps policy x base_case_dim
+(x split); cacqr sweeps variant x base_case_dim x regime.  Grid-shape
+sweeping (the reference's rep-factor loop, qr tune.cpp) plugs in via the
+`grids` argument.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import json
+import os
+from typing import Callable, Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from capital_tpu.bench import harness
+from capital_tpu.models import cholesky, qr
+from capital_tpu.parallel.topology import Grid
+from capital_tpu.utils import tracing
+from capital_tpu.utils.config import BaseCasePolicy
+
+
+@dataclasses.dataclass
+class SweepResult:
+    config_id: str
+    config: dict
+    seconds: float
+    recorder: tracing.Recorder
+
+
+def _model_costs(step: Callable, operand) -> tracing.Recorder:
+    """Capture the alpha-beta model decomposition for one config by tracing
+    (no execution): phase emits fire at trace time."""
+    rec = tracing.Recorder()
+    with rec:
+        jax.eval_shape(step, operand)
+    return rec
+
+
+def run_sweep(
+    name: str,
+    configs: Iterable[tuple[str, dict, Callable]],
+    operand,
+    out_dir: str = ".",
+    iters: int = 2,
+    dtype=None,
+) -> list[SweepResult]:
+    """Measure + model every (config_id, config_dict, step_fn) and write the
+    cost tables.  Returns results sorted best-first by measured time."""
+    dtype = dtype or operand.dtype
+    results: list[SweepResult] = []
+    for cid, cdict, step in configs:
+        rec = _model_costs(step, operand)
+        secs = harness.timed_loop(step, operand, iters=iters)
+        results.append(SweepResult(cid, cdict, secs, rec))
+        print(f"# autotune {name}: {cid}  {secs * 1e3:.3f} ms")
+
+    os.makedirs(out_dir, exist_ok=True)
+    spec = tracing.device_spec()
+    tracing.write_times_table(
+        os.path.join(out_dir, f"{name}_cp_times.txt"),
+        [
+            (r.config_id, r.seconds, r.recorder.estimate_seconds(spec, dtype))
+            for r in results
+        ],
+    )
+    tracing.write_costs_table(
+        os.path.join(out_dir, f"{name}_cp_costs.txt"),
+        [(r.config_id, r.recorder) for r in results],
+    )
+    results.sort(key=lambda r: r.seconds)
+    best = results[0]
+    with open(os.path.join(out_dir, f"{name}_best.json"), "w") as f:
+        json.dump(
+            {
+                "config": best.config,
+                "seconds": best.seconds,
+                "configs_swept": len(results),
+                "device": jax.devices()[0].device_kind,
+            },
+            f,
+            indent=1,
+        )
+    return results
+
+
+# --------------------------------------------------------------------------
+# per-algorithm config spaces (reference tune.cpp sweeps)
+# --------------------------------------------------------------------------
+
+
+def _spd(n: int, dtype) -> jnp.ndarray:
+    rng = np.random.default_rng(0)
+    M = rng.standard_normal((n, n)).astype(np.float32)
+    A = (M + M.T) / np.sqrt(2.0 * n) + 2.0 * np.eye(n, dtype=np.float32)
+    return jnp.asarray(A).astype(dtype)
+
+
+def cholinv_space(
+    grid: Grid,
+    dtype,
+    bc_dims: Iterable[int] = (128, 256, 512, 1024),
+    policies: Iterable[BaseCasePolicy] = (
+        BaseCasePolicy.REPLICATE_COMM_COMP,
+        BaseCasePolicy.NO_REPLICATION,
+    ),
+    splits: Iterable[int] = (1,),
+    modes: Iterable[str] = ("xla",),
+):
+    """policy x bc x split x mode — the reference's decomposition sweep
+    (cholesky tune.cpp:175-253: 3 policies x bcMultiplier range)."""
+    prec = None if jnp.dtype(dtype).itemsize < 4 else "highest"
+    for pol, bc, split, mode in itertools.product(policies, bc_dims, splits, modes):
+        cfg = cholesky.CholinvConfig(
+            base_case_dim=bc, split=split, policy=pol, mode=mode, precision=prec
+        )
+
+        def step(a, cfg=cfg):
+            R, Rinv = cholesky.factor(grid, a, cfg)
+            return R + Rinv
+
+        cid = f"pol{pol.value}_bc{bc}_s{split}_{mode}"
+        yield cid, {
+            "policy": pol.name, "base_case_dim": bc, "split": split, "mode": mode,
+        }, step
+
+
+def cacqr_space(
+    grid: Grid,
+    dtype,
+    bc_dims: Iterable[int] = (128, 256, 512),
+    variants: Iterable[int] = (1, 2),
+    regimes: Iterable[str] = ("auto",),
+):
+    """variant x bc x regime (qr tune.cpp sweeps bcMultiplier x grid shape;
+    regime stands in for grid shape on a fixed device set)."""
+    prec = None if jnp.dtype(dtype).itemsize < 4 else "highest"
+    for variant, bc, regime in itertools.product(variants, bc_dims, regimes):
+        cfg = qr.CacqrConfig(
+            num_iter=variant,
+            regime=regime,
+            cholinv=cholesky.CholinvConfig(base_case_dim=bc, precision=prec),
+            precision=prec,
+        )
+
+        def step(a, cfg=cfg):
+            Q, R = qr.factor(grid, a, cfg)
+            return Q.at[: R.shape[0], : R.shape[1]].add(R.astype(Q.dtype))
+
+        cid = f"v{variant}_bc{bc}_{regime}"
+        yield cid, {"variant": variant, "base_case_dim": bc, "regime": regime}, step
+
+
+def tune_cholinv(
+    grid: Grid,
+    n: int,
+    dtype=jnp.bfloat16,
+    out_dir: str = "autotune_out",
+    prefilter_top_k: int = 0,
+    **space,
+) -> list[SweepResult]:
+    """Sweep cholinv configs.  With prefilter_top_k > 0, the native
+    alpha-beta planner (native.cholinv_predict) ranks the (policy, bc) space
+    first and only the top-k model candidates are measured — the predictive
+    upgrade over the reference's measure-everything sweep (tune.cpp:239-253)."""
+    A = _spd(n, dtype)
+    configs = list(cholinv_space(grid, dtype, **space))
+    if prefilter_top_k and prefilter_top_k < len(configs):
+        from capital_tpu import native
+
+        spec = tracing.device_spec()
+        peak = spec.peak_tflops(dtype) * 1e12 * 0.6
+        preds = []
+        for cid, cdict, step in configs:
+            out, _ = native.cholinv_predict(
+                n, (grid.dx, grid.dy, grid.c),
+                [cdict["base_case_dim"]],
+                [BaseCasePolicy[cdict["policy"]]],
+                peak_flops=peak,
+                itemsize=jnp.dtype(dtype).itemsize,
+                split=cdict["split"],
+            )
+            preds.append(float(out[0, 0]))
+        order = sorted(range(len(configs)), key=preds.__getitem__)
+        kept = [configs[i] for i in order[:prefilter_top_k]]
+        print(
+            f"# autotune cholinv: planner kept {len(kept)}/{len(configs)} configs"
+        )
+        configs = kept
+    return run_sweep("cholinv", configs, A, out_dir, dtype=dtype)
+
+
+def tune_cacqr(
+    grid: Grid, m: int, n: int, dtype=jnp.bfloat16, out_dir: str = "autotune_out", **space
+) -> list[SweepResult]:
+    rng = np.random.default_rng(0)
+    A = jnp.asarray(rng.standard_normal((m, n)).astype(np.float32)).astype(dtype)
+    return run_sweep(
+        "cacqr", cacqr_space(grid, dtype, **space), A, out_dir, dtype=dtype
+    )

@@ -1,0 +1,310 @@
+"""Native host engine: ctypes bindings over capital_native.cpp.
+
+Builds `libcapital_native.so` lazily with g++ (cached by source hash under
+~/.cache/capital_tpu/), binds it with ctypes, and exposes the same-named
+functions as utils/rand48 and utils/layout — every entry point has a pure
+NumPy fallback, so the package works (slower) without a toolchain.
+
+Why native at all, on a TPU framework: the reference's whole runtime is
+C++ (SURVEY §2 note) — on TPU the compute path belongs to XLA/Pallas, and
+the host-side remainder that benefits from native code is the data engine
+(filling/validating N=65536² matrices element-seeded takes seconds of
+vectorized NumPy and allocates 3x transients; the OpenMP loop streams it) and
+the autotune planner's inner search loop.  See native/src/capital_native.cpp.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(__file__), "src", "capital_native.cpp")
+_LOCK = threading.Lock()
+_LIB: ctypes.CDLL | None = None
+_TRIED = False
+
+
+def _cache_dir() -> str:
+    return os.environ.get(
+        "CAPITAL_NATIVE_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache", "capital_tpu"),
+    )
+
+
+def _build() -> str | None:
+    """Compile the shared library, keyed by source hash; returns path or None."""
+    with open(_SRC, "rb") as f:
+        tag = hashlib.sha256(f.read()).hexdigest()[:16]
+    out = os.path.join(_cache_dir(), f"libcapital_native_{tag}.so")
+    if os.path.exists(out):
+        return out
+    os.makedirs(_cache_dir(), exist_ok=True)
+    tmp = out + f".tmp{os.getpid()}"
+    base = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", tmp]
+    for cmd in (base + ["-fopenmp"], base):  # retry without OpenMP
+        try:
+            r = subprocess.run(cmd, capture_output=True, timeout=120)
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if r.returncode == 0:
+            os.replace(tmp, out)
+            return out
+    return None
+
+
+def _lib() -> ctypes.CDLL | None:
+    global _LIB, _TRIED
+    with _LOCK:
+        if _TRIED:
+            return _LIB
+        _TRIED = True
+        path = _build()
+        if path is None:
+            return None
+        lib = ctypes.CDLL(path)
+        i64, u64, i32 = ctypes.c_int64, ctypes.c_uint64, ctypes.c_int32
+        dp = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+        i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+        i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+        lib.fill_symmetric.argtypes = [dp, i64, i64, i64, i64, i64, i32]
+        lib.fill_random.argtypes = [dp, i64, i64, u64, i64, i64, i64, i64]
+        lib.block_to_cyclic.argtypes = [dp, dp, i64, i64, i64, i64]
+        lib.cyclic_to_block.argtypes = [dp, dp, i64, i64, i64, i64]
+        for f in (lib.pack_upper, lib.unpack_upper, lib.pack_lower, lib.unpack_lower):
+            f.argtypes = [dp, dp, i64]
+        lib.cholinv_predict.argtypes = [
+            i64, i64, i64, i64,
+            ctypes.c_double, ctypes.c_double, ctypes.c_double,
+            i64, i64p, i64, i32p, i64, i64, i32, dp,
+        ]
+        lib.cholinv_predict.restype = i64
+        _LIB = lib
+        return _LIB
+
+
+def available() -> bool:
+    return _lib() is not None
+
+
+# --------------------------------------------------------------------------
+# fillers (bit-parity with utils/rand48; fall back to it)
+# --------------------------------------------------------------------------
+
+
+def _norm(sl: slice | None, n: int) -> tuple[int, int]:
+    if sl is None:
+        return 0, n
+    start, stop, step = sl.indices(n)
+    if step != 1:
+        raise ValueError("native fillers need contiguous slices")
+    return start, stop
+
+
+def symmetric(
+    n: int,
+    diagonally_dominant: bool = True,
+    dtype=np.float64,
+    rows: slice | None = None,
+    cols: slice | None = None,
+) -> np.ndarray:
+    lib = _lib()
+    if lib is None:
+        from capital_tpu.utils import rand48
+
+        return rand48.symmetric(n, diagonally_dominant, dtype, rows, cols)
+    r0, r1 = _norm(rows, n)
+    c0, c1 = _norm(cols, n)
+    out = np.empty((r1 - r0, c1 - c0), dtype=np.float64)
+    lib.fill_symmetric(out, n, r0, r1, c0, c1, int(diagonally_dominant))
+    return out.astype(dtype, copy=False)
+
+
+def random(
+    m: int,
+    n: int,
+    key: int = 0,
+    dtype=np.float64,
+    rows: slice | None = None,
+    cols: slice | None = None,
+) -> np.ndarray:
+    lib = _lib()
+    if lib is None:
+        from capital_tpu.utils import rand48
+
+        return rand48.random(m, n, key, dtype, rows, cols)
+    r0, r1 = _norm(rows, m)
+    c0, c1 = _norm(cols, n)
+    out = np.empty((r1 - r0, c1 - c0), dtype=np.float64)
+    lib.fill_random(out, m, n, key, r0, r1, c0, c1)
+    return out.astype(dtype, copy=False)
+
+
+# --------------------------------------------------------------------------
+# repacks (fall back to utils/layout)
+# --------------------------------------------------------------------------
+
+
+def _repack(fn_name, G: np.ndarray, dx: int, dy: int) -> np.ndarray:
+    lib = _lib()
+    if lib is None:
+        from capital_tpu.utils import layout
+
+        return getattr(layout, fn_name)(np.ascontiguousarray(G, np.float64), dx, dy)
+    G = np.ascontiguousarray(G, dtype=np.float64)
+    out = np.empty_like(G)
+    getattr(lib, fn_name)(G, out, G.shape[0], G.shape[1], dx, dy)
+    return out
+
+
+def block_to_cyclic(G: np.ndarray, dx: int, dy: int) -> np.ndarray:
+    return _repack("block_to_cyclic", G, dx, dy)
+
+
+def cyclic_to_block(G: np.ndarray, dx: int, dy: int) -> np.ndarray:
+    return _repack("cyclic_to_block", G, dx, dy)
+
+
+def pack_upper(A: np.ndarray) -> np.ndarray:
+    lib = _lib()
+    n = A.shape[0]
+    if lib is None:
+        from capital_tpu.utils import layout
+
+        return layout.pack_upper(np.asarray(A, np.float64))
+    A = np.ascontiguousarray(A, np.float64)
+    out = np.empty(n * (n + 1) // 2, np.float64)
+    lib.pack_upper(A, out, n)
+    return out
+
+
+def unpack_upper(packed: np.ndarray, n: int) -> np.ndarray:
+    lib = _lib()
+    if lib is None:
+        from capital_tpu.utils import layout
+
+        return layout.unpack_upper(np.asarray(packed, np.float64), n)
+    packed = np.ascontiguousarray(packed, np.float64)
+    out = np.empty((n, n), np.float64)
+    lib.unpack_upper(packed, out, n)
+    return out
+
+
+def pack_lower(A: np.ndarray) -> np.ndarray:
+    lib = _lib()
+    n = A.shape[0]
+    if lib is None:
+        from capital_tpu.utils import layout
+
+        return layout.pack_lower(np.asarray(A, np.float64))
+    A = np.ascontiguousarray(A, np.float64)
+    out = np.empty(n * (n + 1) // 2, np.float64)
+    lib.pack_lower(A, out, n)
+    return out
+
+
+def unpack_lower(packed: np.ndarray, n: int) -> np.ndarray:
+    lib = _lib()
+    if lib is None:
+        from capital_tpu.utils import layout
+
+        return layout.unpack_lower(np.asarray(packed, np.float64), n)
+    packed = np.ascontiguousarray(packed, np.float64)
+    out = np.empty((n, n), np.float64)
+    lib.unpack_lower(packed, out, n)
+    return out
+
+
+# --------------------------------------------------------------------------
+# planner
+# --------------------------------------------------------------------------
+
+
+def cholinv_predict(
+    n: int,
+    grid_shape: tuple[int, int, int],
+    bc_dims,
+    policies,
+    peak_flops: float,
+    bw_bytes_per_s: float = 4.5e10,
+    alpha_s: float = 1e-6,
+    itemsize: int = 2,
+    split: int = 1,
+    complete_inv: bool = True,
+):
+    """Predicted seconds per (policy, bc) config from the alpha-beta model;
+    returns (seconds[num_pol, num_bc], (best_policy_idx, best_bc_idx)).
+
+    The native predictive half of autotune: prune the measured sweep to the
+    model's frontier before spending device time (the reference instead
+    measures every config, tune.cpp:239-253)."""
+    lib = _lib()
+    bcs = np.asarray(list(bc_dims), dtype=np.int64)
+    pols = np.asarray([int(getattr(p, "value", p)) for p in policies], dtype=np.int32)
+    out = np.empty((len(pols), len(bcs)), dtype=np.float64)
+    dx, dy, c = grid_shape
+    if lib is not None:
+        best = lib.cholinv_predict(
+            n, dx, dy, c, peak_flops, bw_bytes_per_s, alpha_s, itemsize,
+            bcs, len(bcs), pols, len(pols), split, int(complete_inv), out,
+        )
+        return out, (int(best) // len(bcs), int(best) % len(bcs))
+    # NumPy fallback: same model (kept in lock-step with the C++ by
+    # tests/test_native.py::test_predict_matches_fallback)
+    for ip, pol in enumerate(pols):
+        for ib, bc in enumerate(bcs):
+            out[ip, ib] = _predict_py(
+                n, dx, dy, c, peak_flops, bw_bytes_per_s, alpha_s, itemsize,
+                int(bc), int(pol), split, complete_inv,
+            )
+    best = int(np.argmin(out))
+    return out, (best // len(bcs), best % len(bcs))
+
+
+def _predict_py(n, dx, dy, c, peak, bw, alpha, item, bc, pol, split, complete_inv):
+    def ring(b, p):
+        return b * (p - 1) / p if p > 1 else 0.0
+
+    def gemm(M, N, K, tri=0.5):
+        p = dx * dy * c
+        d = max(dx, dy)
+        steps = max(1, d // max(c, 1))
+        fl = tri * 2.0 * M * N * K / p
+        comm = steps * (
+            ring(M / dx * K / d * item, dy) + ring(K / d * N / dy * item, dx)
+        ) + (2.0 * M / dx * N / dy * item * (c - 1) / c if c > 1 else 0.0)
+        nc = (2.0 * steps if (dx > 1 or dy > 1) else 0.0) + (1.0 if c > 1 else 0.0)
+        return fl, comm, nc
+
+    p = dx * dy * c
+    acc = [0.0, 0.0, 0.0]
+
+    def add(t):
+        acc[0] += t[0]; acc[1] += t[1]; acc[2] += t[2]
+
+    def walk(w, top):
+        if w <= bc:
+            acc[0] += 2.0 * w**3 / 3.0
+            if p > 1:
+                acc[1] += ring(w * w * item, p)
+                acc[2] += 2.0 if pol >= 2 else 1.0
+            return
+        n1 = max(bc, w >> split)
+        m2 = w - n1
+        walk(n1, False)
+        add(gemm(n1, m2, n1))
+        add(gemm(m2, m2, n1))
+        walk(m2, False)
+        if complete_inv or not top:
+            add(gemm(n1, m2, n1))
+            add(gemm(n1, m2, m2))
+
+    padded = min(bc, n)
+    while padded < n:
+        padded *= 2
+    walk(padded, True)
+    return acc[0] / peak + acc[1] / bw + acc[2] * alpha

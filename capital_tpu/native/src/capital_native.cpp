@@ -1,0 +1,244 @@
+// capital_native: host-side native engine for the capital-tpu framework.
+//
+// The reference (tbennun/capital) is header-only C++ end to end; on TPU the
+// compute path belongs to XLA/Pallas, and what remains host-side native is:
+//
+//   1. the data engine — deterministic coordinate-seeded matrix fillers
+//      (reference src/matrix/structure.hpp:68-130) and the block/cyclic +
+//      packed-triangular repacks (src/util/util.hpp:56-230,
+//      src/matrix/serialize.h) used at the import/export boundary;
+//   2. the schedule planner — an alpha-beta cost evaluator over the cholinv
+//      recursion plan (the predictive half of the reference's autotune
+//      sweeps, autotune/*/tune.cpp), searching (policy, base-case) spaces
+//      before any measurement runs.
+//
+// Exposed as a C ABI for ctypes (no pybind11 in this image).  All matrix
+// buffers are row-major contiguous doubles; the Python layer owns
+// allocation.  Compile: g++ -O3 -std=c++17 -shared -fPIC [-fopenmp].
+
+#include <cstdint>
+#include <cmath>
+#include <cstring>
+#include <algorithm>
+
+#ifdef _OPENMP
+#include <omp.h>
+#endif
+
+extern "C" {
+
+// --------------------------------------------------------------------------
+// rand48 / splitmix64 primitives (bit-parity with utils/rand48.py)
+// --------------------------------------------------------------------------
+
+static inline double drand48_from_seed(uint64_t seed) {
+  // POSIX rand48: X = (seed<<16)|0x330E; X' = (a*X + c) mod 2^48; X'/2^48.
+  const uint64_t A = 0x5DEECE66DULL, C = 0xBULL, MASK = (1ULL << 48) - 1;
+  uint64_t x = ((seed << 16) | 0x330EULL) & MASK;
+  x = (A * x + C) & MASK;
+  return (double)x / 281474976710656.0;  // 2^48
+}
+
+static inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9E3779B97F4A7C15ULL;
+  x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  x = (x ^ (x >> 27)) * 0x94D049BB133111EBULL;
+  return x ^ (x >> 31);
+}
+
+// Symmetric SPD-ready filler: element (r, c) seeded with
+// max(r,c) + n*min(r,c); +n on the diagonal when diag_dom (reference
+// distribute_symmetric, structure.hpp:68-105).  Fills the [r0,r1) x [c0,c1)
+// sub-block into `out` (row-major (r1-r0) x (c1-c0)).
+void fill_symmetric(double* out, int64_t n, int64_t r0, int64_t r1,
+                    int64_t c0, int64_t c1, int32_t diag_dom) {
+  const int64_t cols = c1 - c0;
+#pragma omp parallel for schedule(static)
+  for (int64_t r = r0; r < r1; ++r) {
+    double* row = out + (r - r0) * cols;
+    for (int64_t c = c0; c < c1; ++c) {
+      uint64_t lo = (uint64_t)std::min(r, c), hi = (uint64_t)std::max(r, c);
+      double v = drand48_from_seed(hi + (uint64_t)n * lo);
+      if (diag_dom && r == c) v += (double)n;
+      row[c - c0] = v;
+    }
+  }
+}
+
+// Grid-independent uniform filler (utils/rand48.py `random`): coordinate
+// seed -> splitmix64 -> top 53 bits -> [0,1).
+void fill_random(double* out, int64_t m, int64_t n, uint64_t key,
+                 int64_t r0, int64_t r1, int64_t c0, int64_t c1) {
+  const int64_t cols = c1 - c0;
+  const uint64_t base =
+      splitmix64(splitmix64(key) ^ (((uint64_t)m << 32) | (uint64_t)n));
+#pragma omp parallel for schedule(static)
+  for (int64_t r = r0; r < r1; ++r) {
+    double* row = out + (r - r0) * cols;
+    for (int64_t c = c0; c < c1; ++c) {
+      uint64_t s = base + (uint64_t)r * (uint64_t)n + (uint64_t)c;
+      row[c - c0] = (double)(splitmix64(s) >> 11) / 9007199254740992.0;  // 2^53
+    }
+  }
+}
+
+// --------------------------------------------------------------------------
+// layout repacks (reference util.hpp:56-230 / serialize.h; row-major here)
+// --------------------------------------------------------------------------
+
+// blocked[(x,y) tile-major, tiles (M/dx) x (N/dy)] -> natural global order,
+// where tile (x, y) holds the elements of the element-cyclic distribution:
+// global (i, j) lives at tile (i % dx, j % dy), local (i / dx, j / dy).
+void block_to_cyclic(const double* blocked, double* cyclic, int64_t M,
+                     int64_t N, int64_t dx, int64_t dy) {
+  const int64_t m = M / dx, n = N / dy;
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < M; ++i) {
+    const int64_t x = i % dx, k = i / dx;
+    for (int64_t j = 0; j < N; ++j) {
+      const int64_t y = j % dy, l = j / dy;
+      cyclic[i * N + j] = blocked[(x * m + k) * N + (y * n + l)];
+    }
+  }
+}
+
+void cyclic_to_block(const double* cyclic, double* blocked, int64_t M,
+                     int64_t N, int64_t dx, int64_t dy) {
+  const int64_t m = M / dx, n = N / dy;
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < M; ++i) {
+    const int64_t x = i % dx, k = i / dx;
+    for (int64_t j = 0; j < N; ++j) {
+      const int64_t y = j % dy, l = j / dy;
+      blocked[(x * m + k) * N + (y * n + l)] = cyclic[i * N + j];
+    }
+  }
+}
+
+// Column-packed triangular storage (reference structure.h:37-72): upper
+// column j contributes rows 0..j; lower column j contributes rows j..n-1.
+void pack_upper(const double* A, double* packed, int64_t n) {
+  int64_t w = 0;
+  for (int64_t j = 0; j < n; ++j)
+    for (int64_t i = 0; i <= j; ++i) packed[w++] = A[i * n + j];
+}
+
+void unpack_upper(const double* packed, double* A, int64_t n) {
+  std::memset(A, 0, sizeof(double) * n * n);
+  int64_t w = 0;
+  for (int64_t j = 0; j < n; ++j)
+    for (int64_t i = 0; i <= j; ++i) A[i * n + j] = packed[w++];
+}
+
+void pack_lower(const double* A, double* packed, int64_t n) {
+  int64_t w = 0;
+  for (int64_t j = 0; j < n; ++j)
+    for (int64_t i = j; i < n; ++i) packed[w++] = A[i * n + j];
+}
+
+void unpack_lower(const double* packed, double* A, int64_t n) {
+  std::memset(A, 0, sizeof(double) * n * n);
+  int64_t w = 0;
+  for (int64_t j = 0; j < n; ++j)
+    for (int64_t i = j; i < n; ++i) A[i * n + j] = packed[w++];
+}
+
+// --------------------------------------------------------------------------
+// schedule planner: alpha-beta cost of the cholinv recursion
+// --------------------------------------------------------------------------
+//
+// Walks the same plan the Python side traces (models/cholesky.py plan():
+// window w splits at n1 = max(bc, w >> split) until w <= bc) and accumulates
+// the model of utils/tracing.py: per distributed matmul, SUMMA-schedule
+// flops/comm (gemm_cost); per base case, redundant potrf+trtri flops plus
+// the replication collective (replicate_cost).  Units: seconds, via
+// (peak_flops, bw_Bps, alpha_s).
+
+struct Cost { double flops, comm, ncoll; };
+
+static inline double ring_bytes(double bytes, int64_t p) {
+  return p > 1 ? bytes * (double)(p - 1) / (double)p : 0.0;
+}
+static inline double allreduce_bytes(double bytes, int64_t p) {
+  return p > 1 ? 2.0 * bytes * (double)(p - 1) / (double)p : 0.0;
+}
+
+// SUMMA gemm model (tracing.gemm_cost): C[M,N] += A[M,K]B[K,N].
+static Cost gemm_cost(int64_t M, int64_t N, int64_t K, int64_t dx, int64_t dy,
+                      int64_t c, int64_t item, double tri_frac) {
+  const int64_t p = dx * dy * c;
+  const int64_t d = std::max(dx, dy);
+  const int64_t steps = std::max<int64_t>(1, d / std::max<int64_t>(c, 1));
+  Cost r;
+  r.flops = tri_frac * 2.0 * (double)M * N * K / (double)p;
+  double a_blk = ((double)M / dx) * ((double)K / d) * item;
+  double b_blk = ((double)K / d) * ((double)N / dy) * item;
+  double c_blk = ((double)M / dx) * ((double)N / dy) * item;
+  r.comm = steps * (ring_bytes(a_blk, dy) + ring_bytes(b_blk, dx)) +
+           allreduce_bytes(c_blk, c);
+  r.ncoll = ((dx > 1 || dy > 1) ? 2.0 * steps : 0.0) + (c > 1 ? 1.0 : 0.0);
+  return r;
+}
+
+static void add(Cost* acc, Cost c) {
+  acc->flops += c.flops; acc->comm += c.comm; acc->ncoll += c.ncoll;
+}
+
+// Recursion over the window; mirrors plan()/_recurse() phase structure.
+static void cholinv_walk(int64_t w, int64_t bc, int64_t split, int64_t dx,
+                         int64_t dy, int64_t c, int64_t item, int32_t policy,
+                         int32_t complete_inv, Cost* acc) {
+  const int64_t p = dx * dy * c;
+  if (w <= bc) {
+    // base case: redundant potrf+trtri on the replicated panel (policies
+    // 0/1: allgather over the mesh; 2/3: gather+scatter — same bytes, one
+    // extra collective round)
+    acc->flops += 2.0 * (double)w * w * w / 3.0;
+    if (p > 1) {
+      acc->comm += ring_bytes((double)w * w * item, p);
+      acc->ncoll += (policy >= 2) ? 2.0 : 1.0;
+    }
+    return;
+  }
+  int64_t n1 = std::max(bc, w >> split);
+  int64_t m2 = w - n1;
+  cholinv_walk(n1, bc, split, dx, dy, c, item, policy, 1, acc);
+  // TRSM phase: R12 = R11^-T A12 (trmm, triangular operand halves the flops)
+  add(acc, gemm_cost(n1, m2, n1, dx, dy, c, item, 0.5));
+  // Schur: A22 -= R12^T R12 (syrk: symmetric output halves useful flops)
+  add(acc, gemm_cost(m2, m2, n1, dx, dy, c, item, 0.5));
+  cholinv_walk(m2, bc, split, dx, dy, c, item, policy, 1, acc);
+  if (complete_inv) {  // inverse completion: two trmms
+    add(acc, gemm_cost(n1, m2, n1, dx, dy, c, item, 0.5));
+    add(acc, gemm_cost(n1, m2, m2, dx, dy, c, item, 0.5));
+  }
+}
+
+// Predicted seconds for each (policy, bc) config; out is row-major
+// [num_pol][num_bc].  Returns the flat argmin.
+int64_t cholinv_predict(int64_t n, int64_t dx, int64_t dy, int64_t c,
+                        double peak_flops, double bw_Bps, double alpha_s,
+                        int64_t itemsize, const int64_t* bcs, int64_t num_bc,
+                        const int32_t* policies, int64_t num_pol,
+                        int64_t split, int32_t complete_inv,
+                        double* out_seconds) {
+  int64_t best = 0;
+  for (int64_t ip = 0; ip < num_pol; ++ip) {
+    for (int64_t ib = 0; ib < num_bc; ++ib) {
+      // pad n to a multiple chain of bc like padded_dim()
+      int64_t bc = bcs[ib], padded = std::min(bc, n);
+      while (padded < n) padded *= 2;
+      Cost acc{0, 0, 0};
+      cholinv_walk(padded, bc, split, dx, dy, c, itemsize, policies[ip],
+                   complete_inv, &acc);
+      double s = acc.flops / peak_flops + acc.comm / bw_Bps + acc.ncoll * alpha_s;
+      out_seconds[ip * num_bc + ib] = s;
+      if (s < out_seconds[best]) best = ip * num_bc + ib;
+    }
+  }
+  return best;
+}
+
+int32_t capital_native_abi_version(void) { return 1; }
+
+}  // extern "C"

@@ -1,0 +1,52 @@
+"""Autotune sweep tests (reference autotune/ layer, SURVEY §5.1)."""
+
+import json
+import os
+
+import jax.numpy as jnp
+
+from capital_tpu.autotune import sweep
+from capital_tpu.parallel.topology import Grid
+import jax
+
+
+def test_cholinv_sweep(tmp_path):
+    grid = Grid.square(c=1, devices=jax.devices("cpu")[:1])
+    res = sweep.tune_cholinv(
+        grid, 128, jnp.float32, str(tmp_path),
+        bc_dims=(32, 64), splits=(1,),
+    )
+    assert len(res) == 4  # 2 policies x 2 bc
+    assert res[0].seconds <= res[-1].seconds  # sorted best-first
+    # tables + best-config json written
+    for f in ("cholinv_cp_times.txt", "cholinv_cp_costs.txt", "cholinv_best.json"):
+        assert os.path.exists(tmp_path / f)
+    best = json.loads((tmp_path / "cholinv_best.json").read_text())
+    assert best["config"]["base_case_dim"] in (32, 64)
+    # the model decomposition captured the algorithm phases
+    tags = set(res[0].recorder.stats)
+    assert {"CI::factor_diag", "CI::trsm", "CI::tmu"} <= tags
+    # times table has a Raw column and one row per config
+    lines = (tmp_path / "cholinv_cp_times.txt").read_text().splitlines()
+    assert "Raw" in lines[0] and len(lines) == 5
+
+
+def test_cholinv_sweep_prefiltered(tmp_path):
+    """Native planner prunes the measured space to top-k model candidates."""
+    grid = Grid.square(c=1, devices=jax.devices("cpu")[:1])
+    res = sweep.tune_cholinv(
+        grid, 128, jnp.float32, str(tmp_path),
+        prefilter_top_k=2, bc_dims=(16, 32, 64),
+    )
+    assert len(res) == 2  # pruned from 2 policies x 3 bc = 6
+
+
+def test_cacqr_sweep(tmp_path):
+    grid = Grid.flat(devices=jax.devices("cpu")[:4])
+    res = sweep.tune_cacqr(
+        grid, 512, 32, jnp.float32, str(tmp_path),
+        bc_dims=(32,), variants=(1, 2),
+    )
+    assert len(res) == 2
+    assert {"CQR::gram", "CQR::chol", "CQR::formR"} <= set(res[0].recorder.stats)
+    assert os.path.exists(tmp_path / "cacqr_best.json")

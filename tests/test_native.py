@@ -1,0 +1,105 @@
+"""Native engine tests: C++/NumPy bit-parity and planner lock-step.
+
+The native library must be a drop-in for utils/rand48 + utils/layout —
+every function here asserts exact equality against the pure-Python path.
+"""
+
+import numpy as np
+import pytest
+
+from capital_tpu import native
+from capital_tpu.utils import layout, rand48
+from capital_tpu.utils.config import BaseCasePolicy
+
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="no C++ toolchain — NumPy fallback in use"
+)
+
+
+def test_available():
+    assert native.available()
+
+
+def test_symmetric_parity():
+    for n, dd in [(1, True), (5, True), (17, False), (64, True)]:
+        assert np.array_equal(native.symmetric(n, dd), rand48.symmetric(n, dd))
+    # ground truth from C srand48/drand48 (verify SKILL.md probe)
+    assert native.symmetric(5)[0, 0] == 5.1708280361062897
+
+
+def test_symmetric_subblock():
+    n = 32
+    full = native.symmetric(n)
+    sub = native.symmetric(n, rows=slice(8, 16), cols=slice(4, 30))
+    assert np.array_equal(sub, full[8:16, 4:30])
+
+
+def test_random_parity():
+    assert np.array_equal(native.random(13, 7, key=3), rand48.random(13, 7, key=3))
+    sub = native.random(13, 7, key=3, rows=slice(2, 9), cols=slice(1, 6))
+    assert np.array_equal(sub, rand48.random(13, 7, key=3)[2:9, 1:6])
+
+
+def test_repack_parity():
+    rng = np.random.default_rng(0)
+    G = rng.standard_normal((12, 8))
+    for dx, dy in [(1, 1), (2, 2), (3, 4), (4, 2)]:
+        assert np.array_equal(
+            native.block_to_cyclic(G, dx, dy), layout.block_to_cyclic(G, dx, dy)
+        )
+        assert np.array_equal(
+            native.cyclic_to_block(G, dx, dy), layout.cyclic_to_block(G, dx, dy)
+        )
+
+
+def test_pack_parity():
+    rng = np.random.default_rng(1)
+    for n in (1, 5, 9):
+        U = np.triu(rng.standard_normal((n, n)))
+        assert np.array_equal(native.pack_upper(U), layout.pack_upper(U))
+        assert np.array_equal(native.unpack_upper(native.pack_upper(U), n), U)
+        L = np.tril(rng.standard_normal((n, n)))
+        assert np.array_equal(native.pack_lower(L), layout.pack_lower(L))
+        assert np.array_equal(native.unpack_lower(native.pack_lower(L), n), L)
+
+
+def test_predict_matches_fallback():
+    """C++ planner and the NumPy reference model must stay in lock-step."""
+    bcs = [64, 128, 256]
+    pols = [BaseCasePolicy.REPLICATE_COMM_COMP, BaseCasePolicy.NO_REPLICATION]
+    for grid in [(1, 1, 1), (2, 2, 1), (2, 2, 2)]:
+        out, best = native.cholinv_predict(
+            2048, grid, bcs, pols, peak_flops=1e14,
+        )
+        ref = np.array(
+            [
+                [
+                    native._predict_py(
+                        2048, *grid, 1e14, 4.5e10, 1e-6, 2, bc, p.value, 1, True
+                    )
+                    for bc in bcs
+                ]
+                for p in pols
+            ]
+        )
+        np.testing.assert_allclose(out, ref, rtol=1e-12)
+        assert out[best] == out.min()
+        assert np.all(out > 0)
+
+
+def test_predict_model_sanity():
+    """Replicated base case should beat gather-to-root in predicted collective
+    count; distributed grids pay communication a 1x1x1 grid does not."""
+    bcs = [128]
+    out_multi, _ = native.cholinv_predict(
+        4096, (2, 2, 2), bcs,
+        [BaseCasePolicy.REPLICATE_COMM_COMP, BaseCasePolicy.NO_REPLICATION],
+        peak_flops=1e14,
+    )
+    assert out_multi[0, 0] < out_multi[1, 0]  # fewer collective rounds
+    out_single, _ = native.cholinv_predict(
+        4096, (1, 1, 1), bcs, [BaseCasePolicy.REPLICATE_COMM_COMP],
+        peak_flops=1e14,
+    )
+    assert out_single[0, 0] < out_multi[0, 0]  # no comm term

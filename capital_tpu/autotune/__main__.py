@@ -48,9 +48,7 @@ def main(argv=None) -> None:
     dtype = jnp.dtype(args.dtype)
     space = {"bc_dims": tuple(args.bc)} if args.bc else {}
     if args.alg == "cholinv":
-        grid = Grid.square(c=1, devices=dev[:1]) if len(dev) == 1 else Grid.square(
-            c=1, devices=dev
-        )
+        grid = Grid.square(c=1, devices=dev)
         res = sweep.tune_cholinv(
             grid, args.n, dtype, args.out, prefilter_top_k=args.top_k, **space
         )

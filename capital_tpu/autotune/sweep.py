@@ -69,6 +69,9 @@ def run_sweep(
     """Measure + model every (config_id, config_dict, step_fn) and write the
     cost tables.  Returns results sorted best-first by measured time."""
     dtype = dtype or operand.dtype
+    configs = list(configs)
+    if not configs:
+        raise ValueError(f"autotune sweep {name!r}: no configs to sweep")
     results: list[SweepResult] = []
     for cid, cdict, step in configs:
         rec = _model_costs(step, operand)

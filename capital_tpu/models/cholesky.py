@@ -47,7 +47,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from capital_tpu.ops import lapack, masking
+from capital_tpu.ops import lapack
 from capital_tpu.parallel import summa
 from capital_tpu.parallel.summa import SyrkArgs, TrmmArgs
 from capital_tpu.parallel.topology import Grid
@@ -169,14 +169,17 @@ def _base_case(
         tracing.emit(
             flops=tracing.potrf_trtri_flops(n), comm_bytes=comm, collectives=ncoll
         )
-        # Rebuild the full symmetric panel from its upper triangle: Schur
+        # The leaf window's valid content is its upper triangle (Schur
         # windows arriving from mode='pallas' syrk carry only the upper half
-        # (summa.syrk uplo semantics); for dense-symmetric windows this is a
-        # no-op-equivalent elementwise pass.
-        panel = masking.symmetrize_from(A.astype(bc_dtype), "U")
+        # — summa.syrk uplo semantics; dense-symmetric windows are a
+        # superset).  potrf_trtri_upper factors straight from that triangle
+        # with all transposes inside layout-opaque Pallas kernels — an
+        # XLA-visible leaf `.T` here cascades into full-matrix relayout
+        # copies (see ops/lapack.py:potrf_trtri_upper).
+        panel = A.astype(bc_dtype)
         if not cfg.policy.single_device_compute:
             panel = lax.with_sharding_constraint(panel, grid.replicated_sharding())
-        R, Rinv = lapack.potrf_trtri(panel, uplo="U")
+        R, Rinv = lapack.potrf_trtri_upper(panel)
         return grid.pin(R.astype(A.dtype)), grid.pin(Rinv.astype(A.dtype))
 
 

@@ -150,6 +150,12 @@ def random(
 
 
 def _repack(fn_name, G: np.ndarray, dx: int, dy: int) -> np.ndarray:
+    if G.shape[0] % dx or G.shape[1] % dy:
+        # validated here (not only in the NumPy fallback) so the native path
+        # errors identically instead of silently scrambling the remainder
+        raise ValueError(
+            f"{fn_name}: shape {G.shape} not divisible by grid ({dx}, {dy})"
+        )
     lib = _lib()
     if lib is None:
         from capital_tpu.utils import layout

@@ -49,6 +49,31 @@ def potrf_trtri(A: jnp.ndarray, uplo: str = "U") -> tuple[jnp.ndarray, jnp.ndarr
     return R, trtri(R, uplo)
 
 
+def potrf_trtri_upper(P: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(R, R⁻¹) upper-triangular from a symmetric panel whose **upper**
+    triangle holds the valid content (the lower half may be garbage — e.g. a
+    Schur window produced by an uplo='U' syrk).
+
+    Functionally potrf_trtri(symmetrize_from(P, 'U')), but with every
+    transpose routed through the layout-opaque Pallas kernel
+    (ops/pallas_tpu.transpose): the naive spelling plants `.T` ops at every
+    recursion leaf, and XLA layout assignment answers leaf-sized transposes
+    with whole-graph column-major flips + full-matrix relayout copies
+    (~4.7ms/iter at n=16k on v5e).  Here cholesky/triangular_solve run in
+    their native lower form (no symmetrize pass: cholesky with
+    symmetrize_input=False reads only the lower triangle) and the three
+    transposes stay panel-sized."""
+    from capital_tpu.ops import pallas_tpu
+
+    P_low = pallas_tpu.transpose(P, out_uplo="L")
+    L = lax.linalg.cholesky(P_low, symmetrize_input=False)
+    eye = jnp.eye(P.shape[-1], dtype=P.dtype)
+    Linv = lax.linalg.triangular_solve(L, eye, left_side=True, lower=True)
+    R = pallas_tpu.transpose(L, out_uplo="U")
+    Rinv = pallas_tpu.transpose(Linv, out_uplo="U")
+    return R, Rinv
+
+
 def geqrf(A: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Householder QR returning (Q, R) — the combined geqrf+orgqr capability
     (reference interface.hpp:61-89; upstream never calls these, see

@@ -81,24 +81,28 @@ def _device_budget() -> tuple[int, int | None]:
     return 512, None
 
 
-def default_blocks(m: int, k: int, n: int, itemsize: int = 2) -> tuple[int, int, int]:
+def default_blocks(
+    m: int, k: int, n: int, itemsize: int = 2, tri_operand: bool = False
+) -> tuple[int, int, int]:
     """(bm, bn, bk) block shape, shrunk to each dim's padded size for small
-    operands; multiples of 128 throughout (MXU/lane alignment).  The tile
-    budget is device-gated (_device_budget); on conservative-budget chips the
-    K depth is dtype-budgeted instead (bf16 affords bk=2048 within ~10MB of
-    scoped VMEM, f32 half that)."""
+    operands; multiples of 128 throughout (MXU/lane alignment).  The output
+    tile budget is device-gated (_device_budget); the K depth is
+    dtype-budgeted everywhere (bf16 affords bk=2048, f32 half that — within
+    the raised vmem_limit on big-tile chips, ~10MB of scoped VMEM on the
+    conservative ones).
+
+    tri_operand is accepted for call-site symmetry but currently does not
+    change the choice: at 8192^2 bf16 on v5e (80-iteration in-jit timing),
+    deep K wins for every kernel shape — dense 193 vs 176 TF/s, trmm 152 vs
+    139 useful, syrk 144 vs 134 at bk=2048 vs 1024.  trmm's remaining gap to
+    dense is exactly the masked half-tiles of the bk/2-wide diagonal band
+    (live-pair fraction x dense time predicts the measurement within 2%), so
+    finer K trades that band against dense efficiency and loses."""
     cap, _ = _device_budget()
     bm = max(128, min(cap, _round_up(m, 128)))
     bn = max(128, min(cap, _round_up(n, 128)))
     dtype_bk = 2048 if itemsize <= 2 else 1024
-    if cap > 512 and bm >= cap and bn >= cap:
-        # large square tiles: the measured-optimal config is bk == cap
-        bk_cap = cap
-    else:
-        # skinny/deep-K shapes (e.g. gram contractions): small output tiles
-        # leave VMEM headroom, so amortize over a deeper K panel
-        bk_cap = max(cap, dtype_bk) if cap > 512 else dtype_bk
-    bk = max(128, min(bk_cap, _round_up(k, 128)))
+    bk = max(128, min(dtype_bk, _round_up(k, 128)))
     return bm, bn, bk
 
 
@@ -128,7 +132,7 @@ def _b_live(j: int, k: int, bn: int, bk: int, uplo: str, trans: bool) -> bool:
 
 
 def _make_accumulate(
-    *, a_uplo, a_trans, b_uplo, b_trans, bm, bn, bk, acc_dtype
+    *, a_uplo, a_trans, b_uplo, b_trans, bm, bn, bk, acc_dtype, precision
 ):
     """The shared inner body: mask diagonal-straddling tiles against global
     indices, contract on the MXU, accumulate into VMEM scratch."""
@@ -150,7 +154,8 @@ def _make_accumulate(
                 b = _global_tri_mask(b, r0, c0, b_uplo)
         dn = (((0 if a_trans else 1,), (1 if b_trans else 0,)), ((), ()))
         acc_ref[:] += jax.lax.dot_general(
-            a, b, dimension_numbers=dn, preferred_element_type=acc_dtype
+            a, b, dimension_numbers=dn, preferred_element_type=acc_dtype,
+            precision=precision,
         )
 
     return accumulate
@@ -166,10 +171,53 @@ def _flush(acc_ref, out_ref, alpha, out_uplo, r0, c0):
 
 
 @functools.partial(
+    jax.jit, static_argnames=("out_uplo", "interpret")
+)
+def transpose(
+    X: jnp.ndarray, *, out_uplo: str | None = None, interpret: bool | None = None
+) -> jnp.ndarray:
+    """Xᵀ as an opaque custom call, optionally keeping only `out_uplo` of the
+    result (dead half zeroed regardless of input buffer contents).
+
+    Why a kernel for something XLA does natively: a bare `.T` in the traced
+    graph invites layout assignment to satisfy it with a *bitcast* — flipping
+    the consumer chain to column-major and re-materializing row-major copies
+    at every Mosaic boundary (Mosaic kernels pin {1,0} operands).  Measured on
+    cholinv at n=16k/v5e, the leaf-sized `L.T`s in the base case cascaded into
+    ~4.7ms/iter of full-matrix relayout copies (a 536MB transposed copy of A
+    among them).  A custom call is layout-opaque: the transpose stays exactly
+    as big as the tensor it transposes."""
+    if interpret is None:
+        interpret = _interpret_default()
+    m, n = X.shape
+    bm = max(128, min(512, _round_up(m, 128)))
+    bn = max(128, min(512, _round_up(n, 128)))
+    M, N = _round_up(m, bm), _round_up(n, bn)
+    Xp = jnp.pad(X, ((0, M - m), (0, N - n))) if (M != m or N != n) else X
+
+    def kernel(x_ref, out_ref):
+        i, j = pl.program_id(0), pl.program_id(1)  # out tile (i, j): (bn, bm)
+        t = x_ref[:].T
+        if out_uplo is not None:
+            t = _global_tri_mask(t, i * bn, j * bm, out_uplo)
+        out_ref[:] = t
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(N // bn, M // bm),
+        in_specs=[pl.BlockSpec((bm, bn), lambda i, j: (j, i), memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((bn, bm), lambda i, j: (i, j), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((N, M), X.dtype),
+        interpret=interpret,
+    )(Xp)
+    return out[:n, :m] if (M != m or N != n) else out
+
+
+@functools.partial(
     jax.jit,
     static_argnames=(
         "a_uplo", "a_trans", "b_uplo", "b_trans", "out_uplo", "alpha",
-        "blocks", "interpret", "vmem_limit",
+        "blocks", "interpret", "vmem_limit", "precision",
     ),
 )
 def tri_matmul(
@@ -185,9 +233,15 @@ def tri_matmul(
     blocks: tuple[int, int, int] | None = None,
     interpret: bool | None = None,
     vmem_limit: int | None = None,
+    precision: str | None = None,
 ) -> jnp.ndarray:
     """C = alpha * op(A) @ op(B) with dead blocks of triangular operands /
-    results never visited.  See module docstring."""
+    results never visited.  See module docstring.
+
+    precision: MXU precision for the in-kernel dot_general ('highest' runs
+    f32 operands through full-precision passes).  Without it f32 inputs get
+    the MXU default (bf16-grade mantissa per pass): measured 7e-4 relative
+    residual on an n=1000 f32 cholinv vs 2e-7 with 'highest'."""
     if a_uplo is not None and b_uplo is not None:
         raise ValueError("at most one triangular operand")
     if out_uplo is not None and (a_uplo is not None or b_uplo is not None):
@@ -203,7 +257,9 @@ def tri_matmul(
         raise ValueError(f"contraction mismatch: {A.shape} x {B.shape}")
 
     bm, bn, bk = blocks or default_blocks(
-        am, ak, bnd, jnp.dtype(jnp.result_type(A, B)).itemsize
+        am, ak, bnd,
+        jnp.dtype(jnp.result_type(A, B)).itemsize,
+        tri_operand=(a_uplo is not None or b_uplo is not None),
     )
     M, K, N = _round_up(am, bm), _round_up(ak, bk), _round_up(bnd, bn)
     pa = (M - am, K - ak) if not a_trans else (K - ak, M - am)
@@ -219,7 +275,7 @@ def tri_matmul(
 
     accumulate = _make_accumulate(
         a_uplo=a_uplo, a_trans=a_trans, b_uplo=b_uplo, b_trans=b_trans,
-        bm=bm, bn=bn, bk=bk, acc_dtype=acc_dtype,
+        bm=bm, bn=bn, bk=bk, acc_dtype=acc_dtype, precision=precision,
     )
     a_shape = (bk, bm) if a_trans else (bm, bk)
     b_shape = (bn, bk) if b_trans else (bk, bn)

@@ -217,11 +217,13 @@ def trmm(
         tracing.emit(flops=flops / 2, comm_bytes=comm, collectives=ncoll)
         if args.side == "L":
             out = pallas_tpu.tri_matmul(
-                A, B, a_uplo=args.uplo, a_trans=args.trans_a, alpha=args.alpha
+                A, B, a_uplo=args.uplo, a_trans=args.trans_a,
+                alpha=args.alpha, precision=args.precision,
             )
         elif args.side == "R":
             out = pallas_tpu.tri_matmul(
-                B, A, b_uplo=args.uplo, b_trans=args.trans_a, alpha=args.alpha
+                B, A, b_uplo=args.uplo, b_trans=args.trans_a,
+                alpha=args.alpha, precision=args.precision,
             )
         else:
             raise ValueError(f"side must be 'L' or 'R', got {args.side!r}")
@@ -277,7 +279,7 @@ def syrk(
         out = pallas_tpu.tri_matmul(
             A, A,
             a_trans=args.trans, b_trans=not args.trans,
-            out_uplo=args.uplo, alpha=args.alpha,
+            out_uplo=args.uplo, alpha=args.alpha, precision=args.precision,
         )
         if args.beta != 0.0:
             out = out + args.beta * C

@@ -46,11 +46,12 @@ the CPU mesh test rig exercises identical semantics (tests/conftest.py).
 
 from __future__ import annotations
 
-import functools
+import math
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -170,14 +171,40 @@ def _flush(acc_ref, out_ref, alpha, out_uplo, r0, c0):
     out_ref[:] = res.astype(out_ref.dtype)
 
 
-@functools.partial(
-    jax.jit, static_argnames=("out_uplo", "interpret")
-)
+def _fit_block(b: int, *quantities: int) -> int:
+    """Largest multiple of 128 that is <= b and divides every nonzero
+    quantity (sizes and offsets of buffer views).  Returns 0 when no such
+    block exists — the caller falls back to materializing the view."""
+    g = 0
+    for q in quantities:
+        g = math.gcd(g, q)
+    if g == 0:
+        g = b
+    if g % 128:
+        return 0
+    d = min(b, g) // 128 * 128
+    while d >= 128 and g % d:
+        d -= 128
+    return d if d >= 128 else 0
+
+
+def _window(buf: jnp.ndarray, view: tuple[int, int, int, int]) -> jnp.ndarray:
+    r0, c0, rows, cols = view
+    return lax.slice(buf, (r0, c0), (r0 + rows, c0 + cols))
+
+
 def transpose(
-    X: jnp.ndarray, *, out_uplo: str | None = None, interpret: bool | None = None
+    X: jnp.ndarray,
+    *,
+    in_view: tuple[int, int, int, int] | None = None,
+    out_uplo: str | None = None,
+    out: jnp.ndarray | None = None,
+    out_off: tuple[int, int] = (0, 0),
+    out_dtype=None,
+    interpret: bool | None = None,
 ) -> jnp.ndarray:
-    """Xᵀ as an opaque custom call, optionally keeping only `out_uplo` of the
-    result (dead half zeroed regardless of input buffer contents).
+    """Windowᵀ as an opaque custom call, optionally masked to `out_uplo` of
+    the result (dead half zeroed regardless of input buffer contents).
 
     Why a kernel for something XLA does natively: a bare `.T` in the traced
     graph invites layout assignment to satisfy it with a *bitcast* — flipping
@@ -186,40 +213,95 @@ def transpose(
     cholinv at n=16k/v5e, the leaf-sized `L.T`s in the base case cascaded into
     ~4.7ms/iter of full-matrix relayout copies (a 536MB transposed copy of A
     among them).  A custom call is layout-opaque: the transpose stays exactly
-    as big as the tensor it transposes."""
+    as big as the window it transposes.
+
+    View/in-place extensions (all offsets static):
+      in_view  — (r0, c0, rows, cols): transpose that window of X instead of
+                 all of X (no slice materialization; the index map offsets).
+      out/out_off — write the (cols x rows) result into `out` at out_off and
+                 return the whole updated buffer.  The write is in place
+                 (pallas input_output_aliases): untouched regions of `out`
+                 are preserved, so the caller must treat the passed-in value
+                 as consumed.  `out is X` (self-update) is allowed when the
+                 two windows are disjoint.
+      out_dtype — cast inside the kernel (e.g. read a bf16 window, emit the
+                 f32 panel the base-case factorization wants)."""
     if interpret is None:
         interpret = _interpret_default()
-    m, n = X.shape
-    bm = max(128, min(512, _round_up(m, 128)))
-    bn = max(128, min(512, _round_up(n, 128)))
-    M, N = _round_up(m, bm), _round_up(n, bn)
-    Xp = jnp.pad(X, ((0, M - m), (0, N - n))) if (M != m or N != n) else X
+    ir0, ic0, m, n = in_view if in_view is not None else (0, 0, *X.shape)
+    res_dtype = out.dtype if out is not None else (out_dtype or X.dtype)
 
-    def kernel(x_ref, out_ref):
+    if in_view is None and out is None:
+        # standalone: pad to lane alignment, transpose, crop
+        bm = max(128, min(512, _round_up(m, 128)))
+        bn = max(128, min(512, _round_up(n, 128)))
+        M, N = _round_up(m, bm), _round_up(n, bn)
+        if M != m or N != n:
+            Xp = jnp.pad(X.astype(res_dtype), ((0, M - m), (0, N - n)))
+            res = transpose(Xp, out_uplo=out_uplo, interpret=interpret)
+            return res[:n, :m]
+    else:
+        bm = _fit_block(512, m, ir0, out_off[1])
+        bn = _fit_block(512, n, ic0, out_off[0])
+        if bm == 0 or bn == 0:
+            # unaligned window/offsets: materialize and retry without views
+            Xw = X if in_view is None else _window(X, in_view)
+            res = transpose(
+                Xw, out_uplo=out_uplo, out_dtype=res_dtype, interpret=interpret
+            )
+            if out is not None:
+                return lax.dynamic_update_slice(out, res.astype(out.dtype), out_off)
+            return res
+
+    def kernel(x_ref, *rest):
+        out_ref = rest[-1]
         i, j = pl.program_id(0), pl.program_id(1)  # out tile (i, j): (bn, bm)
         t = x_ref[:].T
         if out_uplo is not None:
             t = _global_tri_mask(t, i * bn, j * bm, out_uplo)
-        out_ref[:] = t
+        out_ref[:] = t.astype(out_ref.dtype)
 
-    out = pl.pallas_call(
+    oa = (ir0 // bm, ic0 // bn)
+    oo = (out_off[0] // bn, out_off[1] // bm)
+    in_specs = [
+        pl.BlockSpec(
+            (bm, bn), lambda i, j: (j + oa[0], i + oa[1]), memory_space=pltpu.VMEM
+        )
+    ]
+    operands = [X]
+    aliases = {}
+    if out is None:
+        out_shape = jax.ShapeDtypeStruct((n, m), res_dtype)
+    else:
+        out_shape = jax.ShapeDtypeStruct(out.shape, out.dtype)
+        if out is X:
+            aliases = {0: 0}
+        else:
+            in_specs.append(pl.BlockSpec(memory_space=pl.ANY))
+            operands.append(out)
+            aliases = {1: 0}
+    res = pl.pallas_call(
         kernel,
-        grid=(N // bn, M // bm),
-        in_specs=[pl.BlockSpec((bm, bn), lambda i, j: (j, i), memory_space=pltpu.VMEM)],
-        out_specs=pl.BlockSpec((bn, bm), lambda i, j: (i, j), memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((N, M), X.dtype),
+        grid=(n // bn, m // bm),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (bn, bm), lambda i, j: (i + oo[0], j + oo[1]), memory_space=pltpu.VMEM
+        ),
+        out_shape=out_shape,
+        input_output_aliases=aliases,
         interpret=interpret,
-    )(Xp)
-    return out[:n, :m] if (M != m or N != n) else out
+    )(*operands)
+    return res
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=(
-        "a_uplo", "a_trans", "b_uplo", "b_trans", "out_uplo", "alpha",
-        "blocks", "interpret", "vmem_limit", "precision",
-    ),
-)
+# NOTE: deliberately NOT wrapped in jax.jit.  The in-place `out` path decides
+# between "alias an operand" and "append a donated buffer operand" by object
+# identity (`out is A` / `out is B`); a jit boundary would hand the function
+# fresh tracers for each argument, the identity test would always fail, and
+# every self-updating call (e.g. cholinv's inverse completion writing one
+# window of Rinv while reading another) would silently pay a full-buffer XLA
+# copy — measured 31 x 1.6ms/iter at n=16k.  Callers jit the enclosing
+# computation instead.
 def tri_matmul(
     A: jnp.ndarray,
     B: jnp.ndarray,
@@ -234,6 +316,10 @@ def tri_matmul(
     interpret: bool | None = None,
     vmem_limit: int | None = None,
     precision: str | None = None,
+    a_view: tuple[int, int, int, int] | None = None,
+    b_view: tuple[int, int, int, int] | None = None,
+    out: jnp.ndarray | None = None,
+    out_off: tuple[int, int] = (0, 0),
 ) -> jnp.ndarray:
     """C = alpha * op(A) @ op(B) with dead blocks of triangular operands /
     results never visited.  See module docstring.
@@ -241,35 +327,83 @@ def tri_matmul(
     precision: MXU precision for the in-kernel dot_general ('highest' runs
     f32 operands through full-precision passes).  Without it f32 inputs get
     the MXU default (bf16-grade mantissa per pass): measured 7e-4 relative
-    residual on an n=1000 f32 cholinv vs 2e-7 with 'highest'."""
+    residual on an n=1000 f32 cholinv vs 2e-7 with 'highest'.
+
+    Buffer views (all offsets/sizes static):
+      a_view/b_view — (r0, c0, rows, cols): the operand is that window of the
+        passed buffer (still transposed by the *_trans flag).  No slice is
+        materialized; the BlockSpec index maps are offset by whole blocks.
+      out/out_off — write the (m x n) result into `out` at out_off in place
+        and return the whole updated buffer (pallas input_output_aliases:
+        untouched regions are preserved; the caller must treat the passed-in
+        `out` value as consumed).  `out` may be the same buffer as A or B
+        (e.g. writing one window of a triangular factor while reading
+        another) provided the read and write windows are disjoint.
+        Incompatible with out_uplo.
+
+    Views require every window size/offset to be divisible by a viable block
+    size (>= 128); otherwise the call transparently falls back to
+    materializing the windows (and a dynamic_update_slice for `out`)."""
     if a_uplo is not None and b_uplo is not None:
         raise ValueError("at most one triangular operand")
     if out_uplo is not None and (a_uplo is not None or b_uplo is not None):
         raise ValueError("out_uplo cannot combine with a triangular operand")
+    if out_uplo is not None and out is not None:
+        raise ValueError("in-place `out` is not supported with out_uplo")
     if interpret is None:
         interpret = _interpret_default()
     if vmem_limit is None and not interpret:
         vmem_limit = _device_budget()[1]
 
-    (am, ak) = A.shape if not a_trans else A.shape[::-1]
-    (bkd, bnd) = B.shape if not b_trans else B.shape[::-1]
+    has_view = a_view is not None or b_view is not None or out is not None
+    ar0, ac0, arr, acc_ = a_view if a_view is not None else (0, 0, *A.shape)
+    br0, bc0, brr, bcc = b_view if b_view is not None else (0, 0, *B.shape)
+    (am, ak) = (acc_, arr) if a_trans else (arr, acc_)
+    (bkd, bnd) = (bcc, brr) if b_trans else (brr, bcc)
     if ak != bkd:
-        raise ValueError(f"contraction mismatch: {A.shape} x {B.shape}")
+        raise ValueError(
+            f"contraction mismatch: {(am, ak)} x {(bkd, bnd)} "
+            f"(A{A.shape} view {a_view}, B{B.shape} view {b_view})"
+        )
 
     bm, bn, bk = blocks or default_blocks(
         am, ak, bnd,
         jnp.dtype(jnp.result_type(A, B)).itemsize,
         tri_operand=(a_uplo is not None or b_uplo is not None),
     )
-    M, K, N = _round_up(am, bm), _round_up(ak, bk), _round_up(bnd, bn)
-    pa = (M - am, K - ak) if not a_trans else (K - ak, M - am)
-    pb = (K - bkd, N - bnd) if not b_trans else (N - bnd, K - bkd)
-    Ap = jnp.pad(A, ((0, pa[0]), (0, pa[1]))) if any(pa) else A
-    Bp = jnp.pad(B, ((0, pb[0]), (0, pb[1]))) if any(pb) else B
+
+    if has_view:
+        # no padding possible on views: blocks must divide every window
+        # size and offset exactly, else materialize and retry
+        bm = _fit_block(bm, am, ac0 if a_trans else ar0,
+                        out_off[0] if out is not None else 0)
+        bk = _fit_block(bk, ak, ar0 if a_trans else ac0,
+                        bc0 if b_trans else br0)
+        bn = _fit_block(bn, bnd, br0 if b_trans else bc0,
+                        out_off[1] if out is not None else 0)
+        if min(bm, bn, bk) == 0:
+            Am = A if a_view is None else _window(A, a_view)
+            Bm = B if b_view is None else _window(B, b_view)
+            res = tri_matmul(
+                Am, Bm, a_uplo=a_uplo, a_trans=a_trans, b_uplo=b_uplo,
+                b_trans=b_trans, out_uplo=out_uplo, alpha=alpha, blocks=blocks,
+                interpret=interpret, vmem_limit=vmem_limit, precision=precision,
+            )
+            if out is not None:
+                return lax.dynamic_update_slice(out, res.astype(out.dtype), out_off)
+            return res
+        M, K, N = am, ak, bnd
+        Ap, Bp = A, B
+    else:
+        M, K, N = _round_up(am, bm), _round_up(ak, bk), _round_up(bnd, bn)
+        pa = (M - am, K - ak) if not a_trans else (K - ak, M - am)
+        pb = (K - bkd, N - bnd) if not b_trans else (N - bnd, K - bkd)
+        Ap = jnp.pad(A, ((0, pa[0]), (0, pa[1]))) if any(pa) else A
+        Bp = jnp.pad(B, ((0, pb[0]), (0, pb[1]))) if any(pb) else B
 
     nm, nk, nn = M // bm, K // bk, N // bn
-    out_dtype = jnp.result_type(A, B)
-    acc_dtype = jnp.promote_types(out_dtype, jnp.float32)
+    out_dtype = out.dtype if out is not None else jnp.result_type(A, B)
+    acc_dtype = jnp.promote_types(jnp.result_type(A, B), jnp.float32)
     if jnp.dtype(acc_dtype).itemsize > 4 and jax.default_backend() == "tpu":
         acc_dtype = jnp.float32
 
@@ -279,12 +413,33 @@ def tri_matmul(
     )
     a_shape = (bk, bm) if a_trans else (bm, bk)
     b_shape = (bn, bk) if b_trans else (bk, bn)
+    # static block offsets of each view, in that operand's buffer axes
+    oa = (ar0 // a_shape[0], ac0 // a_shape[1])
+    ob = (br0 // b_shape[0], bc0 // b_shape[1])
+    oo = (out_off[0] // bm, out_off[1] // bn) if out is not None else (0, 0)
+
+    if out is None:
+        out_shape = jax.ShapeDtypeStruct((M, N), out_dtype)
+    else:
+        out_shape = jax.ShapeDtypeStruct(out.shape, out.dtype)
+
+    def alias_setup(n_scalars: int):
+        """(extra operand list, input_output_aliases) for the in-place out."""
+        if out is None:
+            return [], {}
+        if out is A:
+            return [], {n_scalars: 0}
+        if out is B:
+            return [], {n_scalars + 1: 0}
+        return [out], {n_scalars + 2: 0}
+
     common = dict(
-        out_shape=jax.ShapeDtypeStruct((M, N), out_dtype),
+        out_shape=out_shape,
         scratch_shapes=[pltpu.VMEM((bm, bn), acc_dtype)],
         cost_estimate=pl.CostEstimate(
             flops=2 * M * N * K,
-            bytes_accessed=(M * K + K * N + M * N) * jnp.dtype(out_dtype).itemsize,
+            bytes_accessed=(M * K + K * N + M * N)
+            * jnp.dtype(jnp.result_type(A, B)).itemsize,
             transcendentals=0,
         ),
         interpret=interpret,
@@ -292,7 +447,8 @@ def tri_matmul(
 
     if a_uplo is None and b_uplo is None and out_uplo is None:
         # ---- dense: plain revisit-k blocked matmul -----------------------
-        def dense_kernel(a_ref, b_ref, out_ref, acc_ref):
+        def dense_kernel(a_ref, b_ref, *rest):
+            out_ref, acc_ref = rest[-2], rest[-1]
             i, j, k = pl.program_id(0), pl.program_id(1), pl.program_id(2)
 
             @pl.when(k == 0)
@@ -305,30 +461,39 @@ def tri_matmul(
             def _():
                 _flush(acc_ref, out_ref, alpha, None, 0, 0)
 
-        out = pl.pallas_call(
+        extra, aliases = alias_setup(0)
+        in_specs = [
+            pl.BlockSpec(
+                a_shape,
+                (lambda i, j, k: (k + oa[0], i + oa[1]))
+                if a_trans
+                else (lambda i, j, k: (i + oa[0], k + oa[1])),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                b_shape,
+                (lambda i, j, k: (j + ob[0], k + ob[1]))
+                if b_trans
+                else (lambda i, j, k: (k + ob[0], j + ob[1])),
+                memory_space=pltpu.VMEM,
+            ),
+        ] + [pl.BlockSpec(memory_space=pl.ANY) for _ in extra]
+        res = pl.pallas_call(
             dense_kernel,
             grid=(nm, nn, nk),
-            in_specs=[
-                pl.BlockSpec(
-                    a_shape,
-                    (lambda i, j, k: (k, i)) if a_trans else (lambda i, j, k: (i, k)),
-                    memory_space=pltpu.VMEM,
-                ),
-                pl.BlockSpec(
-                    b_shape,
-                    (lambda i, j, k: (j, k)) if b_trans else (lambda i, j, k: (k, j)),
-                    memory_space=pltpu.VMEM,
-                ),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec(
-                (bm, bn), lambda i, j, k: (i, j), memory_space=pltpu.VMEM
+                (bm, bn),
+                lambda i, j, k: (i + oo[0], j + oo[1]),
+                memory_space=pltpu.VMEM,
             ),
+            input_output_aliases=aliases,
             compiler_params=pltpu.CompilerParams(
                 dimension_semantics=("parallel", "parallel", "arbitrary"),
                 vmem_limit_bytes=vmem_limit,
             ),
             **common,
-        )(Ap, Bp)
+        )(Ap, Bp, *extra)
 
     elif out_uplo is not None:
         # ---- tri-output (syrk): enumerate live output tiles --------------
@@ -361,16 +526,16 @@ def tri_matmul(
             in_specs=[
                 pl.BlockSpec(
                     a_shape,
-                    (lambda p, k, io, jo: (k, io[p]))
+                    (lambda p, k, io, jo: (k + oa[0], io[p] + oa[1]))
                     if a_trans
-                    else (lambda p, k, io, jo: (io[p], k)),
+                    else (lambda p, k, io, jo: (io[p] + oa[0], k + oa[1])),
                     memory_space=pltpu.VMEM,
                 ),
                 pl.BlockSpec(
                     b_shape,
-                    (lambda p, k, io, jo: (jo[p], k))
+                    (lambda p, k, io, jo: (jo[p] + ob[0], k + ob[1]))
                     if b_trans
-                    else (lambda p, k, io, jo: (k, jo[p])),
+                    else (lambda p, k, io, jo: (k + ob[0], jo[p] + ob[1])),
                     memory_space=pltpu.VMEM,
                 ),
             ],
@@ -379,7 +544,7 @@ def tri_matmul(
             ),
             scratch_shapes=[pltpu.VMEM((bm, bn), acc_dtype)],
         )
-        out = pl.pallas_call(
+        res = pl.pallas_call(
             syrk_kernel,
             grid_spec=grid_spec,
             out_shape=common["out_shape"],
@@ -393,7 +558,7 @@ def tri_matmul(
         # tiles in the dead half are never written by the kernel; Mosaic
         # zero-initializes outputs only per-visited-block, so blank the dead
         # half explicitly (cheap elementwise, fuses with the crop below)
-        out = _global_tri_mask(out, 0, 0, out_uplo)
+        res = _global_tri_mask(res, 0, 0, out_uplo)
 
     else:
         # ---- tri-operand (trmm): enumerate live (tile-row, k) pairs ------
@@ -404,17 +569,6 @@ def tri_matmul(
                 for k in range(nk)
                 if _a_live(i, k, bm, bk, a_uplo, a_trans)
             ]
-            # grid: (nn, pairs) — pairs innermost so the out tile (i, j)
-            # is revisited consecutively across its live k run
-            to = jnp.asarray(np.array([p[0] for p in pairs], np.int32))
-            ko = jnp.asarray(np.array([p[1] for p in pairs], np.int32))
-            first = np.zeros(len(pairs), np.int32)
-            last = np.zeros(len(pairs), np.int32)
-            for idx, (i, _) in enumerate(pairs):
-                if idx == 0 or pairs[idx - 1][0] != i:
-                    first[idx] = 1
-                if idx == len(pairs) - 1 or pairs[idx + 1][0] != i:
-                    last[idx] = 1
         else:
             pairs = [
                 (j, k)
@@ -422,20 +576,23 @@ def tri_matmul(
                 for k in range(nk)
                 if _b_live(j, k, bn, bk, b_uplo, b_trans)
             ]
-            to = jnp.asarray(np.array([p[0] for p in pairs], np.int32))
-            ko = jnp.asarray(np.array([p[1] for p in pairs], np.int32))
-            first = np.zeros(len(pairs), np.int32)
-            last = np.zeros(len(pairs), np.int32)
-            for idx, (j, _) in enumerate(pairs):
-                if idx == 0 or pairs[idx - 1][0] != j:
-                    first[idx] = 1
-                if idx == len(pairs) - 1 or pairs[idx + 1][0] != j:
-                    last[idx] = 1
+        # grid: (other-dim, pairs) — pairs innermost so each out tile is
+        # revisited consecutively across its live k run
+        to = jnp.asarray(np.array([p[0] for p in pairs], np.int32))
+        ko = jnp.asarray(np.array([p[1] for p in pairs], np.int32))
+        first = np.zeros(len(pairs), np.int32)
+        last = np.zeros(len(pairs), np.int32)
+        for idx, (t, _) in enumerate(pairs):
+            if idx == 0 or pairs[idx - 1][0] != t:
+                first[idx] = 1
+            if idx == len(pairs) - 1 or pairs[idx + 1][0] != t:
+                last[idx] = 1
         first = jnp.asarray(first)
         last = jnp.asarray(last)
         a_is_tri = a_uplo is not None
 
-        def trmm_kernel(to_ref, ko_ref, fi_ref, la_ref, a_ref, b_ref, out_ref, acc_ref):
+        def trmm_kernel(to_ref, ko_ref, fi_ref, la_ref, a_ref, b_ref, *rest):
+            out_ref, acc_ref = rest[-2], rest[-1]
             q, p = pl.program_id(0), pl.program_id(1)
             t, k = to_ref[p], ko_ref[p]
             i, j = (t, q) if a_is_tri else (q, t)
@@ -452,51 +609,56 @@ def tri_matmul(
 
         if a_is_tri:
             a_map = (
-                (lambda q, p, to, ko, fi, la: (ko[p], to[p]))
+                (lambda q, p, to, ko, fi, la: (ko[p] + oa[0], to[p] + oa[1]))
                 if a_trans
-                else (lambda q, p, to, ko, fi, la: (to[p], ko[p]))
+                else (lambda q, p, to, ko, fi, la: (to[p] + oa[0], ko[p] + oa[1]))
             )
             b_map = (
-                (lambda q, p, to, ko, fi, la: (q, ko[p]))
+                (lambda q, p, to, ko, fi, la: (q + ob[0], ko[p] + ob[1]))
                 if b_trans
-                else (lambda q, p, to, ko, fi, la: (ko[p], q))
+                else (lambda q, p, to, ko, fi, la: (ko[p] + ob[0], q + ob[1]))
             )
-            out_map = lambda q, p, to, ko, fi, la: (to[p], q)
+            out_map = lambda q, p, to, ko, fi, la: (to[p] + oo[0], q + oo[1])
             n_outer = nn
         else:
             a_map = (
-                (lambda q, p, to, ko, fi, la: (ko[p], q))
+                (lambda q, p, to, ko, fi, la: (ko[p] + oa[0], q + oa[1]))
                 if a_trans
-                else (lambda q, p, to, ko, fi, la: (q, ko[p]))
+                else (lambda q, p, to, ko, fi, la: (q + oa[0], ko[p] + oa[1]))
             )
             b_map = (
-                (lambda q, p, to, ko, fi, la: (to[p], ko[p]))
+                (lambda q, p, to, ko, fi, la: (to[p] + ob[0], ko[p] + ob[1]))
                 if b_trans
-                else (lambda q, p, to, ko, fi, la: (ko[p], to[p]))
+                else (lambda q, p, to, ko, fi, la: (ko[p] + ob[0], to[p] + ob[1]))
             )
-            out_map = lambda q, p, to, ko, fi, la: (q, to[p])
+            out_map = lambda q, p, to, ko, fi, la: (q + oo[0], to[p] + oo[1])
             n_outer = nm
 
+        extra, aliases = alias_setup(4)
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=4,
             grid=(n_outer, len(pairs)),
             in_specs=[
                 pl.BlockSpec(a_shape, a_map, memory_space=pltpu.VMEM),
                 pl.BlockSpec(b_shape, b_map, memory_space=pltpu.VMEM),
-            ],
+            ]
+            + [pl.BlockSpec(memory_space=pl.ANY) for _ in extra],
             out_specs=pl.BlockSpec((bm, bn), out_map, memory_space=pltpu.VMEM),
             scratch_shapes=[pltpu.VMEM((bm, bn), acc_dtype)],
         )
-        out = pl.pallas_call(
+        res = pl.pallas_call(
             trmm_kernel,
             grid_spec=grid_spec,
             out_shape=common["out_shape"],
             cost_estimate=common["cost_estimate"],
+            input_output_aliases=aliases,
             interpret=interpret,
             compiler_params=pltpu.CompilerParams(
                 dimension_semantics=("parallel", "arbitrary"),
                 vmem_limit_bytes=vmem_limit,
             ),
-        )(to, ko, first, last, Ap, Bp)
+        )(to, ko, first, last, Ap, Bp, *extra)
 
-    return out[:am, :bnd] if (M != am or N != bnd) else out
+    if out is not None:
+        return res
+    return res[:am, :bnd] if (M != am or N != bnd) else res

@@ -197,50 +197,76 @@ def gemm(
     return grid.pin(out)
 
 
+def _take_view(X, view):
+    if X is None or view is None:
+        return X
+    return lax.slice(X, view[:2], (view[0] + view[2], view[1] + view[3]))
+
+
 def trmm(
     grid: Grid,
     A: jnp.ndarray,
     B: jnp.ndarray,
     args: TrmmArgs = TrmmArgs(),
     mode: str = "xla",
+    *,
+    a_view: tuple[int, int, int, int] | None = None,
+    b_view: tuple[int, int, int, int] | None = None,
+    out: jnp.ndarray | None = None,
+    out_off: tuple[int, int] = (0, 0),
 ) -> jnp.ndarray:
     """B <- alpha * op(tri(A)) @ B   (side L)   or   alpha * B @ op(tri(A))
     (side R) — reference summa.hpp:47-83.
 
     The triangular operand is dense + masked; the mask fuses into the matmul
     (no packed storage — SURVEY §7.1).  mode='pallas' on a single-device
-    grid skips the dead blocks on the MXU instead (ops/pallas_tpu.py)."""
+    grid skips the dead blocks on the MXU instead (ops/pallas_tpu.py).
+
+    a_view/b_view select static windows of the passed buffers as the
+    operands, and out/out_off writes the result into a window of `out`
+    (returning the whole updated buffer).  On the single-device pallas path
+    these compile to offset index maps / an in-place aliased write (no slice
+    or scatter materialization, ops/pallas_tpu.py); every other path
+    materializes the windows and a dynamic_update_slice — identical
+    semantics, so callers can be written once against views (the recursion
+    in models/cholesky.py is)."""
+    a_dims = (a_view[2], a_view[3]) if a_view is not None else A.shape
+    b_dims = (b_view[2], b_view[3]) if b_view is not None else B.shape
     if mode == "pallas" and grid.num_devices == 1 and args.diag != "U":
         flops, comm, ncoll = tracing.gemm_cost(
-            grid, B.shape[0], B.shape[1], A.shape[0], jnp.result_type(A, B)
+            grid, b_dims[0], b_dims[1], a_dims[0], jnp.result_type(A, B)
         )
         tracing.emit(flops=flops / 2, comm_bytes=comm, collectives=ncoll)
         if args.side == "L":
-            out = pallas_tpu.tri_matmul(
+            return pallas_tpu.tri_matmul(
                 A, B, a_uplo=args.uplo, a_trans=args.trans_a,
                 alpha=args.alpha, precision=args.precision,
+                a_view=a_view, b_view=b_view, out=out, out_off=out_off,
             )
         elif args.side == "R":
-            out = pallas_tpu.tri_matmul(
+            return pallas_tpu.tri_matmul(
                 B, A, b_uplo=args.uplo, b_trans=args.trans_a,
                 alpha=args.alpha, precision=args.precision,
+                a_view=b_view, b_view=a_view, out=out, out_off=out_off,
             )
-        else:
-            raise ValueError(f"side must be 'L' or 'R', got {args.side!r}")
-        return out
-    T = masking.take_triangle(A, args.uplo)
+        raise ValueError(f"side must be 'L' or 'R', got {args.side!r}")
+    Aw = _take_view(A, a_view)
+    Bw = _take_view(B, b_view)
+    T = masking.take_triangle(Aw, args.uplo)
     if args.diag == "U":
         T = masking.with_unit_diagonal(T)
     Top = T.T if args.trans_a else T
     if args.side == "L":
-        out = _matmul(grid, Top, B, mode, args.precision)
+        res = _matmul(grid, Top, Bw, mode, args.precision)
     elif args.side == "R":
-        out = _matmul(grid, B, Top, mode, args.precision)
+        res = _matmul(grid, Bw, Top, mode, args.precision)
     else:
         raise ValueError(f"side must be 'L' or 'R', got {args.side!r}")
     if args.alpha != 1.0:
-        out = args.alpha * out
-    return grid.pin(out)
+        res = args.alpha * res
+    if out is not None:
+        return grid.pin(lax.dynamic_update_slice(out, res.astype(out.dtype), out_off))
+    return grid.pin(res)
 
 
 def syrk(
@@ -249,6 +275,9 @@ def syrk(
     C: jnp.ndarray | None = None,
     args: SyrkArgs = SyrkArgs(),
     mode: str = "xla",
+    *,
+    a_view: tuple[int, int, int, int] | None = None,
+    c_view: tuple[int, int, int, int] | None = None,
 ) -> jnp.ndarray:
     """Symmetric rank-k update (reference summa.hpp:86-161, which lowers syrk
     to an explicit grid transpose + gemm; here the transpose is a logical
@@ -270,8 +299,9 @@ def syrk(
         # there); skipping the symmetric redundancy is where the ~1.65x
         # comes from.  Callers must read only the live triangle
         # (models/cholesky.py symmetrizes its base-case panel from 'U').
-        n_out = A.shape[1] if args.trans else A.shape[0]
-        k_in = A.shape[0] if args.trans else A.shape[1]
+        a_dims = (a_view[2], a_view[3]) if a_view is not None else A.shape
+        n_out = a_dims[1] if args.trans else a_dims[0]
+        k_in = a_dims[0] if args.trans else a_dims[1]
         flops, comm, ncoll = tracing.gemm_cost(
             grid, n_out, n_out, k_in, jnp.result_type(A)
         )
@@ -280,16 +310,18 @@ def syrk(
             A, A,
             a_trans=args.trans, b_trans=not args.trans,
             out_uplo=args.uplo, alpha=args.alpha, precision=args.precision,
+            a_view=a_view, b_view=a_view,
         )
         if args.beta != 0.0:
-            out = out + args.beta * C
+            out = out + args.beta * _take_view(C, c_view)
         return out
-    Aop = (A.T, A) if args.trans else (A, A.T)
+    Aw = _take_view(A, a_view)
+    Aop = (Aw.T, Aw) if args.trans else (Aw, Aw.T)
     out = _matmul(grid, Aop[0], Aop[1], mode, args.precision)
     if args.alpha != 1.0:
         out = args.alpha * out
     if args.beta != 0.0:
-        out = out + args.beta * grid.pin(C)
+        out = out + args.beta * grid.pin(_take_view(C, c_view))
     return grid.pin(out)
 
 

@@ -137,3 +137,21 @@ def test_cholinv_pallas_mode_end_to_end(grid1):
     R, Rinv = jax.jit(lambda a: cholesky.factor(grid1, a, cfg))(A)
     assert float(residual.cholesky_residual(A, R)) < 1e-13
     assert float(residual.cholesky_inverse_residual(R, Rinv)) < 1e-13
+
+
+def test_cholinv_pallas_mode_aligned_views(grid1):
+    """bc=128 at n=512: every window size/offset is a multiple of 128, so
+    this drives the ALIGNED in-place path end to end — offset index maps for
+    the trmm/syrk operand views and aliased `out`/`out_off` writes for the
+    leaf transposes, TRSM, and inverse completion (the n=192/bc=64 test
+    above always takes the _fit_block==0 materializing fallback, which
+    would mask a regression in the aligned kernels)."""
+    n = 512
+    A = jnp.asarray(rand48.symmetric(n))
+    cfg = cholesky.CholinvConfig(base_case_dim=128, mode="pallas")
+    R, Rinv = jax.jit(lambda a: cholesky.factor(grid1, a, cfg))(A)
+    assert float(residual.cholesky_residual(A, R)) < 1e-13
+    assert float(residual.cholesky_inverse_residual(R, Rinv)) < 1e-13
+    # dead halves must be true zeros (mask inside the aliased writes)
+    assert float(jnp.abs(jnp.tril(R, -1)).max()) == 0.0
+    assert float(jnp.abs(jnp.tril(Rinv, -1)).max()) == 0.0

@@ -60,7 +60,7 @@ def _peak_tflops(kind: str, dtype) -> float:
 
 
 def main() -> None:
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else 16384
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 32768
     dtype = jnp.dtype(sys.argv[2]) if len(sys.argv) > 2 else jnp.bfloat16
     iters = int(sys.argv[3]) if len(sys.argv) > 3 else 3
 

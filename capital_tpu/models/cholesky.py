@@ -314,9 +314,35 @@ def factor(
     else:
         Ap = A
     Ap = grid.pin(Ap)
-    Rp = grid.pin(jnp.zeros((p, p), dtype=A.dtype))
-    RIp = grid.pin(jnp.zeros((p, p), dtype=A.dtype))
-    R, Rinv = _recurse(grid, Ap, 0, plan(p, cfg), cfg, True, Rp, RIp)
+    node = plan(p, cfg)
+
+    def _leaves_aligned(nd: PlanNode, tile: int) -> bool:
+        if nd.is_base:
+            return nd.off % tile == 0 and nd.n % tile == 0
+        return all(_leaves_aligned(c, tile) for c in nd.top)
+
+    tile = min(512, cfg.base_case_dim)
+    if grid.num_devices == 1 and _leaves_aligned(node, tile):
+        # every tile of the upper triangle (diag leaf windows + TRSM /
+        # inverse-completion panels) is written exactly once by the
+        # recursion, on the aligned-pallas AND fallback paths alike — only
+        # the dead lower half (plus the skipped top-right Rinv window when
+        # complete_inv=False) needs actual zeros.  Gated on leaf/tile
+        # alignment: split>=2 plans produce leaves smaller than the tile, a
+        # diagonal tile then contains sub-diagonal area outside every leaf
+        # window, and skipping jnp.zeros would return hardware garbage there
+        # (invisible on CPU interpret, which zero-fills unvisited blocks).
+        Rp = pallas_tpu.zeros_dead_lower(p, A.dtype, tile)
+        extra = (
+            ()
+            if cfg.complete_inv or node.is_base
+            else ((0, node.top[0].n, node.top[0].n, p - node.top[0].n),)
+        )
+        RIp = pallas_tpu.zeros_dead_lower(p, A.dtype, tile, extra=extra)
+    else:
+        Rp = grid.pin(jnp.zeros((p, p), dtype=A.dtype))
+        RIp = grid.pin(jnp.zeros((p, p), dtype=A.dtype))
+    R, Rinv = _recurse(grid, Ap, 0, node, cfg, True, Rp, RIp)
     R, Rinv = grid.pin(R), grid.pin(Rinv)
     if p != n:
         R, Rinv = R[:n, :n], Rinv[:n, :n]

@@ -42,6 +42,19 @@ Entries in an operand's dead triangle are treated as zero regardless of
 buffer contents.  Accumulation is f32 (input dtype if wider, off-TPU) in
 VMEM scratch.  On non-TPU backends everything runs in interpreter mode so
 the CPU mesh test rig exercises identical semantics (tests/conftest.py).
+
+**Buffer views and in-place outputs** (tri_matmul, transpose): operands can
+be static windows of larger buffers (offset index maps — no slice
+materialization) and results can be written into a window of an existing
+buffer via `input_output_aliases`, preserving every untouched region.  The
+combination lets a blocked algorithm keep its factors in flat buffers and
+run each phase straight against them — cholinv's recursion reads R11inv /
+R12 / R22inv through views and writes leaf, TRSM, and inverse-completion
+panels in place, which removed ~6ms/iter of assembly HBM traffic at n=16k
+on v5e (per-level concatenates, scatter chains, relayout copies).  Windows
+whose sizes/offsets don't fit a viable block size transparently fall back
+to materializing.  `zeros_dead_lower` rounds this out by zero-filling only
+the tiles the algorithm will never write.
 """
 
 from __future__ import annotations
@@ -191,6 +204,62 @@ def _fit_block(b: int, *quantities: int) -> int:
 def _window(buf: jnp.ndarray, view: tuple[int, int, int, int]) -> jnp.ndarray:
     r0, c0, rows, cols = view
     return lax.slice(buf, (r0, c0), (r0 + rows, c0 + cols))
+
+
+def zeros_dead_lower(
+    p: int,
+    dtype,
+    tile: int,
+    extra: tuple[tuple[int, int, int, int], ...] = (),
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """A p x p buffer whose strictly-sub-diagonal `tile`-blocks (plus any
+    `extra` (r0, c0, rows, cols) windows) are zero-filled; every OTHER tile
+    is left unwritten, i.e. undefined garbage on hardware.
+
+    For callers that overwrite the whole upper triangle anyway (cholinv's
+    factor buffers: leaf windows + TRSM/inverse-completion panels cover it
+    exactly), this halves the buffer-initialization HBM traffic vs
+    jnp.zeros — ~0.8ms/iter at n=16k bf16 on v5e, 2x that at 32k.  Falls
+    back to a plain jnp.zeros when the tiling cannot be expressed."""
+    if interpret is None:
+        interpret = _interpret_default()
+    if tile % 128 or p % tile or tile < 128:
+        return jnp.zeros((p, p), dtype)
+    nt = p // tile
+    tiles = [(i, j) for i in range(nt) for j in range(nt) if i > j]
+    for (r0, c0, rr, cc) in extra:
+        if r0 % tile or c0 % tile or rr % tile or cc % tile:
+            return jnp.zeros((p, p), dtype)
+        tiles += [
+            (r0 // tile + i, c0 // tile + j)
+            for i in range(rr // tile)
+            for j in range(cc // tile)
+        ]
+    if not tiles:
+        return jnp.zeros((p, p), dtype)
+    tiles = sorted(set(tiles))
+    io = jnp.asarray(np.array([t[0] for t in tiles], np.int32))
+    jo = jnp.asarray(np.array([t[1] for t in tiles], np.int32))
+
+    def kernel(io_ref, jo_ref, out_ref):
+        del io_ref, jo_ref
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(len(tiles),),
+        in_specs=[],
+        out_specs=pl.BlockSpec(
+            (tile, tile), lambda q, io, jo: (io[q], jo[q]), memory_space=pltpu.VMEM
+        ),
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((p, p), dtype),
+        interpret=interpret,
+    )(io, jo)
 
 
 def transpose(

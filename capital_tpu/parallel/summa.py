@@ -200,7 +200,7 @@ def gemm(
 def _take_view(X, view):
     if X is None or view is None:
         return X
-    return lax.slice(X, view[:2], (view[0] + view[2], view[1] + view[3]))
+    return pallas_tpu._window(X, view)
 
 
 def trmm(

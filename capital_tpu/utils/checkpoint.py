@@ -1,0 +1,160 @@
+"""Checkpoint / resume for long-running factorizations and sweeps.
+
+The reference has **no** checkpoint capability (SURVEY §5.4: factor outputs
+live only in the in-memory `info` pack, cholinv.h:32-33, and a preempted run
+restarts from nothing).  This module goes beyond parity: it persists named
+arrays + JSON metadata atomically, and wraps the framework's iterative
+algorithms so a preempted run resumes from the last saved state.
+
+Design choices:
+
+* Plain ``.npz`` + ``meta.json`` (no orbax dependency surface): factors are
+  dense 2D arrays, synchronous writes are fine at these sizes, and the files
+  are inspectable with nothing but numpy.  Sharded `jax.Array`s are gathered
+  to host before writing (checkpointing is a host-side concern; the restore
+  re-pins to whatever grid the caller provides).
+* Atomic: metadata travels INSIDE the single .npz (as a JSON string entry)
+  and the file is renamed into place, so a preemption mid-write can never
+  leave arrays paired with stale metadata — there is exactly one file to
+  tear, and rename is atomic.  A meta.json is also written afterwards as a
+  human-readable convenience view; it is never read back.
+* Content-addressed resume key: callers pass the config/input fingerprint;
+  ``load`` returns None on any mismatch so a stale checkpoint can never be
+  resumed into a different problem.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Any, Mapping
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def save(path: str, arrays: Mapping[str, Any], meta: dict | None = None) -> None:
+    """Atomically persist `arrays` (+ JSON-serializable `meta`) at `path`
+    (a directory).  Arrays and metadata land in ONE file via one atomic
+    rename; no interleaving of writes can produce arrays with stale meta."""
+    if "__meta__" in arrays:
+        raise ValueError("'__meta__' is reserved for the embedded metadata")
+    os.makedirs(path, exist_ok=True)
+    host = {k: np.asarray(v) for k, v in arrays.items()}
+    host["__meta__"] = np.frombuffer(
+        json.dumps(meta or {}).encode(), dtype=np.uint8
+    )
+    fd, tmp = tempfile.mkstemp(dir=path, suffix=".npz.tmp")
+    os.close(fd)
+    try:
+        with open(tmp, "wb") as f:
+            np.savez(f, **host)
+        os.replace(tmp, os.path.join(path, "arrays.npz"))
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    # convenience view only — load() never reads it
+    with open(os.path.join(path, "meta.json"), "w") as f:
+        json.dump(meta or {}, f, indent=1)
+
+
+def load(path: str, expect_meta: dict | None = None):
+    """Restore (arrays, meta) from `path`, or None when absent/mismatched.
+
+    `expect_meta`: every (key, value) must match the stored meta — pass the
+    problem fingerprint (shape, dtype, config) so a checkpoint from a
+    different run is rejected rather than resumed."""
+    npz = os.path.join(path, "arrays.npz")
+    if not os.path.exists(npz):
+        return None
+    with np.load(npz) as z:
+        arrays = {k: z[k] for k in z.files}
+    meta = json.loads(arrays.pop("__meta__").tobytes().decode())
+    for k, v in (expect_meta or {}).items():
+        if meta.get(k) != v:
+            return None
+    return arrays, meta
+
+
+def fingerprint(A, **config) -> dict:
+    """A cheap problem identity: shape/dtype plus a content probe (corner
+    checksums, not a full hash — checkpoints are advisory, the gate only
+    needs to reject obviously-different inputs)."""
+    Ah = np.asarray(A[: min(64, A.shape[0]), : min(64, A.shape[1])], np.float64)
+    return dict(
+        shape=list(A.shape),
+        dtype=str(jnp.dtype(A.dtype)),
+        probe=float(np.sum(Ah)),
+        **config,
+    )
+
+
+def newton_resumable(
+    grid,
+    A,
+    cfg=None,
+    *,
+    checkpoint_dir: str,
+    chunk: int = 8,
+):
+    """Newton-Schulz inverse with host-level checkpointing every `chunk`
+    iterations.  A preempted run re-invoked with the same arguments resumes
+    from the last completed chunk instead of iterating from X0.
+
+    The in-jit variant (models/inverse.newton) runs the whole while_loop on
+    device; mid-jit state cannot be checkpointed, so this wrapper re-expresses
+    the loop as host-stepped chunks of `chunk` iterations — the standard
+    trade for resumability in iterative solvers.  Returns (Ainv, iters).
+    """
+    import jax
+
+    from capital_tpu.models import inverse as inv_mod
+
+    cfg = cfg or inv_mod.NewtonConfig()
+    tol = cfg.tol
+    if tol is None:
+        tol = 50.0 * float(jnp.finfo(A.dtype).eps)
+    fp = fingerprint(A, alg="newton", chunk=chunk, tol=tol, mode=cfg.mode)
+
+    n = A.shape[0]
+    eye = jnp.eye(n, dtype=A.dtype)
+    state = load(checkpoint_dir, expect_meta=fp)
+    if state is not None:
+        arrays, meta = state
+        X = jnp.asarray(arrays["X"])
+        done = int(meta["iters"])
+        if meta.get("resid", float("inf")) < tol:
+            return X, done  # already converged: resume is a no-op
+    else:
+        norm1 = jnp.max(jnp.sum(jnp.abs(A), axis=0))
+        norminf = jnp.max(jnp.sum(jnp.abs(A), axis=1))
+        X = A.T / (norm1 * norminf)
+        done = 0
+
+    @jax.jit
+    def step(X, A):
+        # one chunk of Newton iterations starting from X (not X0): reuse the
+        # in-jit loop body by treating X as the running iterate
+        from capital_tpu.parallel import summa
+        from capital_tpu.parallel.summa import GemmArgs
+
+        gargs = GemmArgs(precision=cfg.precision)
+
+        def body(_, X):
+            AX = summa.gemm(grid, A, X, args=gargs, mode=cfg.mode)
+            return summa.gemm(grid, X, 2.0 * eye - AX, args=gargs, mode=cfg.mode)
+
+        X = jax.lax.fori_loop(0, chunk, body, X)
+        AX = summa.gemm(grid, A, X, args=gargs, mode=cfg.mode)
+        r = jnp.linalg.norm(eye - AX) / jnp.sqrt(jnp.asarray(n, A.dtype))
+        return X, r
+
+    r = None
+    while done < cfg.max_iter:
+        X, r = step(X, A)
+        done += chunk
+        save(checkpoint_dir, {"X": X}, {**fp, "iters": done, "resid": float(r)})
+        if float(r) < tol:
+            break
+    return X, done

@@ -136,3 +136,45 @@ class TestReviewRegressions:
         assert top_split(128, cfg) == 64
         assert top_split(100, cfg) == 64  # padded to 128, split at 64
         assert top_split(24, cfg) == 24  # single base-case window
+
+
+def test_zeros_fast_path_gated_on_leaf_alignment(monkeypatch):
+    """split>=2 plans produce leaves smaller than the zero-fill tile; the
+    dead-lower fast path must fall back to full jnp.zeros there or real
+    hardware gets garbage below the diagonal (invisible on CPU interpret,
+    which zero-fills unvisited blocks — hence this structural assertion)."""
+    from capital_tpu.models import cholesky as chol
+    from capital_tpu.ops import pallas_tpu
+
+    calls = []
+    real = pallas_tpu.zeros_dead_lower
+
+    def spy(p, dtype, tile, extra=(), interpret=None):
+        calls.append(tile)
+        return real(p, dtype, tile, extra=extra, interpret=interpret)
+
+    monkeypatch.setattr(pallas_tpu, "zeros_dead_lower", spy)
+    import jax
+    from capital_tpu.parallel.topology import Grid
+
+    grid1 = Grid.square(c=1, devices=jax.devices()[:1])
+    A = jnp.asarray(rand48.symmetric(512, dtype=jnp.float64))
+
+    # aligned plan (split=1, bc=128): fast path taken
+    cfg = chol.CholinvConfig(base_case_dim=128, split=1, mode="pallas")
+    chol.factor(grid1, A, cfg)
+    assert calls, "aligned plan should use the dead-lower fast path"
+
+    # misaligned plan (split=2 -> 128-wide leaves at non-tile offsets for
+    # bc=256): must NOT use the fast path
+    calls.clear()
+    cfg = chol.CholinvConfig(base_case_dim=256, split=2, mode="pallas")
+    node = chol.plan(chol.padded_dim(2048, 256), cfg)
+
+    def leaves(nd):
+        return [nd] if nd.is_base else leaves(nd.top[0]) + leaves(nd.top[1])
+
+    if any(lf.n % 256 or lf.off % 256 for lf in leaves(node)):
+        A2 = jnp.asarray(rand48.symmetric(2048, dtype=jnp.float64))
+        chol.factor(grid1, A2, cfg)
+        assert not calls, "misaligned leaves must fall back to jnp.zeros"

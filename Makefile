@@ -1,0 +1,28 @@
+# Convenience targets mirroring the reference's Makefile surface
+# (all / benchmarking / tune / clean — reference Makefile:1-29).  The real
+# build is standard Python packaging (pyproject.toml); the native host
+# engine compiles itself lazily (capital_tpu/native/__init__.py).
+
+PY ?= python
+
+.PHONY: all test benchmarking tune native clean
+
+all: test
+
+test:
+	$(PY) -m pytest tests/ -x -q
+
+# the reference's `make benchmarking` builds the bench drivers; here they
+# are modules — run the whole driver suite on small shapes as a smoke
+benchmarking:
+	$(PY) -m capital_tpu.bench suite --n 1024 --m 8192 --k 256
+
+tune:
+	$(PY) -m capital_tpu.autotune cholinv --n 2048 --out autotune_out
+
+native:
+	$(PY) -c "from capital_tpu import native; print('native engine available:', native.available())"
+
+clean:
+	rm -rf autotune_out .pytest_cache
+	find . -name __pycache__ -type d -exec rm -rf {} +

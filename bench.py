@@ -27,6 +27,7 @@ Usage: python bench.py [N] [dtype] [iters]
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -59,7 +60,26 @@ def _peak_tflops(kind: str, dtype) -> float:
     return peak
 
 
+def _enable_compile_cache() -> None:
+    """Persistent XLA compilation cache next to this file: the n=32768
+    flagship program takes ~8-10 min to compile cold on v5e but <1 min from
+    cache, so warmed runs (CI/driver re-runs on the same machine) skip the
+    wait.  Overridable/disable-able via JAX_COMPILATION_CACHE_DIR=''."""
+    cache = os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+    )
+    if not cache:
+        return
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 10.0)
+    except Exception:
+        pass  # older jax without these flags: cold compile, still correct
+
+
 def main() -> None:
+    _enable_compile_cache()
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 32768
     dtype = jnp.dtype(sys.argv[2]) if len(sys.argv) > 2 else jnp.bfloat16
     iters = int(sys.argv[3]) if len(sys.argv) > 3 else 3

@@ -37,7 +37,7 @@ class BaseCasePolicy(enum.Enum):
                             explicit constraint on the panel and lets the
                             SPMD partitioner choose placement (which may
                             gather to fewer devices) — see
-                            models/cholesky.py:_base_case
+                            models/cholesky.py:_base_case_into
       NO_REPLICATION_OVERLAP reference overlaps the scatter with trtri
                             (policy.h:416-514); XLA's latency-hiding
                             scheduler owns overlap on TPU — same mapping as

@@ -28,7 +28,7 @@ import dataclasses
 import itertools
 import json
 import os
-from typing import Callable, Iterable, Optional
+from typing import Callable, Iterable
 
 import jax
 import jax.numpy as jnp

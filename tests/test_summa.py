@@ -98,3 +98,39 @@ class TestTranspose:
         At = summa.transpose(g, _put(g, A))
         assert At.sharding == g.face_sharding()
         np.testing.assert_array_equal(np.asarray(At), A.T)
+
+
+class TestViews:
+    """summa.trmm/syrk buffer-view + in-place-out API: on multi-device /
+    non-pallas paths these materialize windows and scatter the result
+    (parallel/summa.py), so the semantics must match hand-done slicing
+    regardless of path taken."""
+
+    @pytest.mark.parametrize("mode", MODES)
+    def test_trmm_views_and_out(self, grid, mode):
+        buf = rand48.random(64, 64, key=11)
+        out0 = rand48.random(64, 64, key=12)
+        # A operand = upper-tri window at (0,0,32,32); B = window (0,32,32,32)
+        want_blk = np.triu(buf[:32, :32]).T @ buf[:32, 32:]
+        args = TrmmArgs(side="L", uplo="U", trans_a=True)
+        got = summa.trmm(
+            grid, _put(grid, buf), _put(grid, buf), args, mode=mode,
+            a_view=(0, 0, 32, 32), b_view=(0, 32, 32, 32),
+            out=_put(grid, out0), out_off=(32, 0),
+        )
+        want = out0.copy()
+        want[32:, 0:32] = want_blk
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-12)
+
+    @pytest.mark.parametrize("mode", MODES)
+    def test_syrk_views(self, grid, mode):
+        buf = rand48.random(64, 64, key=13)
+        C = rand48.random(64, 64, key=14)
+        W = buf[:32, 32:]
+        want = -(W.T @ W) + 1.0 * C[32:, 32:]
+        args = SyrkArgs(trans=True, alpha=-1.0, beta=1.0)
+        got = summa.syrk(
+            grid, _put(grid, buf), _put(grid, C), args, mode=mode,
+            a_view=(0, 32, 32, 32), c_view=(32, 32, 32, 32),
+        )
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-12)

@@ -24,7 +24,6 @@ peak-relative north star *is* the baseline).
 from __future__ import annotations
 
 import json
-import statistics
 import time
 from typing import Callable
 
@@ -45,7 +44,10 @@ def timed_loop(
     iters: int = 3,
     repeats: int = 3,
 ) -> float:
-    """Median per-iteration seconds of `step`, run `iters` times inside jit.
+    """Per-iteration seconds of `step`, run `iters` times inside jit —
+    the min-over-repeats of each endpoint (1 and iters+1 trips),
+    differenced; escalates the trip count when the delta is below the
+    tunnel noise floor.  Raises if it never resolves.
 
     `step(operand) -> array of operand's shape/dtype` must consume all the
     outputs it wants timed (see module docstring on DCE).  The perturbation
@@ -70,8 +72,30 @@ def timed_loop(
         return time.perf_counter() - t0
 
     run(1)  # compile (dynamic trip count -> one executable reused for both k)
-    deltas = [run(iters + 1) - run(1) for _ in range(repeats)]
-    return statistics.median(deltas) / iters
+    # Noise discipline (same as bench.py): host walls through the TPU tunnel
+    # carry multi-ms jitter, so difference the MIN of each endpoint — a
+    # single paired delta can even go negative for sub-ms steps, which once
+    # let an autotune sweep crown a config with a negative "time".
+    base = min(run(1) for _ in range(repeats + 2))
+    full = min(run(iters + 1) for _ in range(repeats + 2))
+    t = (full - base) / iters
+    if t <= 0.0:
+        # still inside the noise floor: grow the loop until the delta is
+        # resolvable, then normalize
+        k = iters
+        while t <= 0.0 and k < 4096:
+            k *= 8
+            full = min(run(k + 1) for _ in range(repeats))
+            t = (full - base) / k
+    if t <= 0.0:
+        # never resolved: refuse to return a fake number (a silent floor
+        # here once let a noise artifact win an autotune sweep)
+        raise RuntimeError(
+            f"timed_loop could not resolve a positive per-iteration time "
+            f"(delta {t:.3e}s at {k} iterations — step is far below the "
+            f"host-wall noise floor)"
+        )
+    return t
 
 
 def report(

@@ -33,7 +33,6 @@ import time
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 
 # peak dense-matmul TFLOP/s per chip, by (device kind substring, dtype).
@@ -99,18 +98,14 @@ def main() -> None:
         precision=None if jnp.dtype(dtype).itemsize < 4 else "highest",
     )
 
-    rng = np.random.default_rng(0)
-    # SPD with strong diagonal dominance: Wigner-scaled noise + n*I, built on
-    # device to keep host memory modest at large n
-    M = jnp.asarray(rng.standard_normal((n, n)).astype(np.float32))
+    # well-conditioned SPD operand, generated on device (shared helper:
+    # 3I diagonal shift — the Wigner edge sits at exactly 2, so a 2I shift
+    # can graze a zero eigenvalue and NaN an f32/bf16 factorization
+    # depending on the RNG stream; an f32 host staging array would also be
+    # a 4.3GB transient at n=32768)
+    from capital_tpu.bench.drivers import _spd
 
-    @jax.jit
-    def make_spd(M):
-        A = (M + M.T) / jnp.sqrt(2.0 * n)
-        return (A + 2.0 * jnp.eye(n, dtype=M.dtype)).astype(dtype)
-
-    A = jax.block_until_ready(make_spd(M))
-    del M
+    A = _spd(n, dtype)
 
     @jax.jit
     def loop(a, eps, iters):

@@ -75,7 +75,13 @@ def run_sweep(
     results: list[SweepResult] = []
     for cid, cdict, step in configs:
         rec = _model_costs(step, operand)
-        secs = harness.timed_loop(step, operand, iters=iters)
+        try:
+            secs = harness.timed_loop(step, operand, iters=iters)
+        except RuntimeError as e:
+            # below the measurement noise floor: record nothing for this
+            # config rather than aborting the sweep and losing the rest
+            print(f"# autotune {name}: {cid}  UNRESOLVED ({e})")
+            continue
         results.append(SweepResult(cid, cdict, secs, rec))
         print(f"# autotune {name}: {cid}  {secs * 1e3:.3f} ms")
 
@@ -92,6 +98,10 @@ def run_sweep(
         os.path.join(out_dir, f"{name}_cp_costs.txt"),
         [(r.config_id, r.recorder) for r in results],
     )
+    if not results:
+        raise RuntimeError(
+            f"autotune sweep {name!r}: no config produced a resolvable time"
+        )
     results.sort(key=lambda r: r.seconds)
     best = results[0]
     with open(os.path.join(out_dir, f"{name}_best.json"), "w") as f:

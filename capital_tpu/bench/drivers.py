@@ -28,7 +28,6 @@ import sys
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from capital_tpu.bench import harness
 from capital_tpu.models import cholesky, inverse, qr
@@ -54,15 +53,16 @@ def _spd(n: int, dtype, seed: int = 0) -> jnp.ndarray:
     """Well-conditioned SPD test matrix, built on device (Wigner + dominant
     diagonal — same spectrum family as the reference's distribute_symmetric
     diagonal dominance, structure.hpp:87-89)."""
-    rng = np.random.default_rng(seed)
-    M = jnp.asarray(rng.standard_normal((n, n)).astype(np.float32))
-
     @jax.jit
-    def make(M):
+    def make(key):
+        M = jax.random.normal(key, (n, n), dtype=jnp.float32)
         A = (M + M.T) / jnp.sqrt(2.0 * n)
-        return (A + 2.0 * jnp.eye(n, dtype=M.dtype)).astype(dtype)
+        # 3I, not 2I: the Wigner semicircle edge sits at exactly 2, so a
+        # 2I shift leaves lambda_min grazing zero and f32 cholesky can NaN
+        # depending on the RNG stream
+        return (A + 3.0 * jnp.eye(n, dtype=M.dtype)).astype(dtype)
 
-    return jax.block_until_ready(make(M))
+    return jax.block_until_ready(make(jax.random.key(seed)))
 
 
 def _grid(args) -> Grid:
@@ -140,13 +140,21 @@ def cacqr(args) -> dict:
         regime=args.regime,
         precision=None if dtype.itemsize < 4 else "highest",
     )
-    rng = np.random.default_rng(0)
-    A = jnp.asarray(rng.standard_normal((args.m, args.n)).astype(np.float32)).astype(dtype)
+    # generate on device directly at the target dtype (an f32 staging
+    # buffer alone is 8GB at the 2M x 1024 BASELINE shape)
+    A = jax.block_until_ready(
+        jax.random.normal(jax.random.key(0), (args.m, args.n), dtype=dtype)
+    )
 
     def step(a):
         Q, R = qr.factor(grid, a, cfg)
         # fold R into the tall carry via a slice-add so the carry keeps A's
-        # shape while both outputs stay live
+        # shape while both outputs stay live (the carry is Q-shaped, so the
+        # loop factors its own running output — same discipline as
+        # bench.py's cholinv loop).  NOTE: this keeps ~3 Q-sized buffers
+        # live; the 2M x 1024 BASELINE shape needs ~16.3GB and OOMs a
+        # single 16GB v5e — that row is an 8-chip configuration (BASELINE
+        # "across 8 ranks"); the single-chip proxy is m=1M.
         return Q.at[: R.shape[0], : R.shape[1]].add(R.astype(Q.dtype))
 
     t = harness.timed_loop(step, A, iters=args.iters)
@@ -167,9 +175,8 @@ def cacqr(args) -> dict:
 def summa_gemm(args) -> dict:
     grid = _grid(args)
     dtype = jnp.dtype(args.dtype)
-    rng = np.random.default_rng(0)
-    A = jnp.asarray(rng.standard_normal((args.m, args.k)).astype(np.float32)).astype(dtype)
-    B = jnp.asarray(rng.standard_normal((args.k, args.n)).astype(np.float32)).astype(dtype)
+    A = jax.random.normal(jax.random.key(0), (args.m, args.k), dtype)
+    B = jax.random.normal(jax.random.key(1), (args.k, args.n), dtype)
     gargs = summa.GemmArgs(precision=None if dtype.itemsize < 4 else "highest")
 
     def step(a):

@@ -84,7 +84,7 @@ def timed_loop(
         # resolvable, then normalize
         k = iters
         while t <= 0.0 and k < 4096:
-            k *= 8
+            k = min(k * 8, 4096)
             full = min(run(k + 1) for _ in range(repeats))
             t = (full - base) / k
     if t <= 0.0:

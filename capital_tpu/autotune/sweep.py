@@ -77,7 +77,7 @@ def run_sweep(
         rec = _model_costs(step, operand)
         try:
             secs = harness.timed_loop(step, operand, iters=iters)
-        except RuntimeError as e:
+        except harness.MeasurementUnresolved as e:
             # below the measurement noise floor: record nothing for this
             # config rather than aborting the sweep and losing the rest
             print(f"# autotune {name}: {cid}  UNRESOLVED ({e})")
@@ -124,10 +124,11 @@ def run_sweep(
 
 
 def _spd(n: int, dtype) -> jnp.ndarray:
-    rng = np.random.default_rng(0)
-    M = rng.standard_normal((n, n)).astype(np.float32)
-    A = (M + M.T) / np.sqrt(2.0 * n) + 2.0 * np.eye(n, dtype=np.float32)
-    return jnp.asarray(A).astype(dtype)
+    # one SPD builder for every harness consumer (3I shift + on-device
+    # generation — see drivers._spd for the numerical rationale)
+    from capital_tpu.bench.drivers import _spd as _drivers_spd
+
+    return _drivers_spd(n, dtype)
 
 
 def cholinv_space(
@@ -227,8 +228,9 @@ def tune_cholinv(
 def tune_cacqr(
     grid: Grid, m: int, n: int, dtype=jnp.bfloat16, out_dir: str = "autotune_out", **space
 ) -> list[SweepResult]:
-    rng = np.random.default_rng(0)
-    A = jnp.asarray(rng.standard_normal((m, n)).astype(np.float32)).astype(dtype)
+    A = jax.block_until_ready(
+        jax.random.normal(jax.random.key(0), (m, n), dtype=dtype)
+    )
     return run_sweep(
         "cacqr", cacqr_space(grid, dtype, **space), A, out_dir, dtype=dtype
     )

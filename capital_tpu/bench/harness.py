@@ -38,6 +38,14 @@ def peak_tflops(device=None, dtype=jnp.bfloat16) -> float:
     return tracing.device_spec(device).peak_tflops(dtype)
 
 
+class MeasurementUnresolved(RuntimeError):
+    """timed_loop could not resolve a positive per-iteration time — the step
+    is below the host-wall noise floor even at the escalated trip cap.
+    Distinct from generic RuntimeError so sweep drivers can skip noise-floor
+    configs without also swallowing real failures (XlaRuntimeError — OOM,
+    compile errors — subclasses RuntimeError)."""
+
+
 def timed_loop(
     step: Callable[[jnp.ndarray], jnp.ndarray],
     operand: jnp.ndarray,
@@ -90,7 +98,7 @@ def timed_loop(
     if t <= 0.0:
         # never resolved: refuse to return a fake number (a silent floor
         # here once let a noise artifact win an autotune sweep)
-        raise RuntimeError(
+        raise MeasurementUnresolved(
             f"timed_loop could not resolve a positive per-iteration time "
             f"(delta {t:.3e}s at {k} iterations — step is far below the "
             f"host-wall noise floor)"

@@ -48,9 +48,12 @@ def run(base: argparse.Namespace, scale: int = 1) -> list[dict]:
     go(f"recursive cholesky N=16384 2x2 grid ({d4} devices)", drivers.cholinv,
        n=max(512, 16384 // scale), devices=d4, c=1)
     d8 = 8 if ndev >= 8 else ndev
-    go(f"cacqr2 2Mx1024 tree ({d8} devices)", drivers.cacqr,
-       m=max(2048, 2**21 // scale), n=max(128, 1024 // scale), devices=0,
-       variant=2)
+    # the 2M x 1024 row is an 8-device configuration; keep per-device work
+    # constant when fewer are present (three Q-sized buffers at the full m
+    # need ~16.3GB — measured OOM on one 15.75GB v5e)
+    m8 = max(2048, 2**21 * d8 // 8 // scale)
+    go(f"cacqr2 2Mx1024 tree ({d8} devices, m={m8})", drivers.cacqr,
+       m=m8, n=max(128, 1024 // scale), devices=d8, variant=2)
     go("spd inverse via cholesky", drivers.spd_inverse,
        n=max(256, 4096 // scale))
     return out

@@ -67,3 +67,33 @@ def test_newton_resumable_matches_direct_and_resumes(tmp_path, grid1):
     Xb, _ = checkpoint.newton_resumable(grid1, B, cfg, checkpoint_dir=p, chunk=4)
     errb = float(jnp.linalg.norm(jnp.eye(n) - B @ Xb)) / np.sqrt(n)
     assert errb < 1e-12
+
+
+def test_newton_resumable_midrun_resume(tmp_path, grid1):
+    """A run capped before convergence leaves a checkpoint; a re-invocation
+    with a higher cap continues from it (and a third call on the converged
+    state is a no-op short-circuit)."""
+    import dataclasses
+
+    n = 32
+    A = jnp.asarray(rand48.symmetric(n, dtype=jnp.float64))
+    p = str(tmp_path / "newton-mid")
+
+    capped = dataclasses.replace(inverse.NewtonConfig(), max_iter=4)
+    X1, it1 = checkpoint.newton_resumable(grid1, A, capped, checkpoint_dir=p, chunk=4)
+    assert it1 == 4
+    st = checkpoint.load(p)
+    assert st is not None and st[1]["iters"] == 4
+    err1 = float(jnp.linalg.norm(jnp.eye(n) - A @ X1)) / np.sqrt(n)
+    assert err1 > 1e-12  # genuinely unconverged at the cap
+
+    full = inverse.NewtonConfig()
+    X2, it2 = checkpoint.newton_resumable(grid1, A, full, checkpoint_dir=p, chunk=4)
+    assert it2 > 4  # continued beyond the stored state, not restarted at 0
+    err2 = float(jnp.linalg.norm(jnp.eye(n) - A @ X2)) / np.sqrt(n)
+    assert err2 < 1e-12
+
+    # converged state: resume is a no-op returning the stored iterate
+    X3, it3 = checkpoint.newton_resumable(grid1, A, full, checkpoint_dir=p, chunk=4)
+    assert it3 == it2
+    np.testing.assert_array_equal(np.asarray(X3), np.asarray(X2))

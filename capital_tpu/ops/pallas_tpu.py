@@ -151,6 +151,12 @@ def _make_accumulate(
     """The shared inner body: mask diagonal-straddling tiles against global
     indices, contract on the MXU, accumulate into VMEM scratch."""
 
+    # Mosaic's in-kernel dot_general supports only DEFAULT and HIGHEST
+    # (no 3-pass HIGH): round the request up so callers that pass 'high'
+    # get full passes instead of NotImplementedError at lowering time
+    if precision == "high":
+        precision = "highest"
+
     def accumulate(a_ref, b_ref, acc_ref, i, j, k):
         a = a_ref[:]
         b = b_ref[:]

@@ -113,10 +113,17 @@ def main() -> None:
             R, Rinv = cholesky.factor(grid, carry, cfg)
             # data-dependent carry consuming BOTH outputs: eps is a runtime
             # scalar (0.0 at call time) so XLA cannot fold the perturbation
-            # away and dead-code-eliminate the factorization — slicing the
-            # carry or consuming only R lets the whole Rinv computation (half
-            # the useful flops) be DCE'd and inflates the number.
-            return carry + eps.astype(carry.dtype) * (R + Rinv)
+            # away and dead-code-eliminate the factorization.  Consuming one
+            # element of each output is sufficient — R/Rinv are produced by
+            # chains of aliased pallas custom calls XLA cannot slice through,
+            # so every kernel still runs (verified on-device: elem-coupling
+            # 37.6 ms/iter vs 38.3 for full-sum consumption vs 18.0 when the
+            # Rinv chain is *actually* DCE'd, n=16k).  Consuming only R would
+            # kill the inverse-completion half of the work; a full-matrix
+            # carry add (carry + eps*(R+Rinv)) costs ~4 extra HBM passes of
+            # pure harness overhead (~10 ms/iter at n=32k).
+            d = R[0, 0] + Rinv[0, 0]
+            return carry.at[0, 0].add(eps.astype(carry.dtype) * d)
 
         out = jax.lax.fori_loop(0, iters, body, a)
         return jnp.sum(out, dtype=jnp.float32)

@@ -61,6 +61,16 @@ def timed_loop(
     outputs it wants timed (see module docstring on DCE).  The perturbation
     scalar `eps` is 0.0 at call time but runtime-valued, so XLA cannot fold
     the iteration chain away.
+
+    The carry consumes the step output with a FULL-matrix add, deliberately:
+    for arbitrary steps (xla-mode SUMMA, plain matmul chains) a one-element
+    coupling would let the algebraic simplifier legitimately narrow slices
+    into the producing ops and shrink the measured work.  bench.py's flagship
+    loop uses the cheaper element coupling only because its outputs come
+    through chains of aliased pallas custom calls XLA cannot slice through
+    (verified on-device — see the comment there).  The cost: up to ~4 extra
+    HBM passes of harness overhead per iteration, so suite/autotune numbers
+    are slightly conservative.
     """
 
     @jax.jit

@@ -33,10 +33,11 @@ Supported structure flags (at most one triangular operand):
                    without materializing Aᵀ (the index map fetches the
                    transposed tile, dot_general contracts axis 0)
   b_uplo/b_trans — B triangular
-  out_uplo       — only the named triangle of C is computed, rest zeroed
-                   (syrk semantics, engine.h:114-130: C = AᵀA is symmetric,
-                   so cholinv's Schur phase keeps/reads only the upper
-                   triangle — models/cholesky.py)
+  out_uplo       — only the named triangle of the result is computed; the
+                   rest is zeroed with beta=0 and UNDEFINED with the fused
+                   c/beta accumulate (syrk semantics, engine.h:114-130:
+                   C = AᵀA is symmetric, so cholinv's Schur phase keeps/reads
+                   only the upper triangle — models/cholesky.py)
 
 Entries in an operand's dead triangle are treated as zero regardless of
 buffer contents.  Accumulation is f32 (input dtype if wider, off-TPU) in
@@ -181,12 +182,19 @@ def _make_accumulate(
     return accumulate
 
 
-def _flush(acc_ref, out_ref, alpha, out_uplo, r0, c0):
+def _flush(acc_ref, out_ref, alpha, out_uplo, r0, c0, c_ref=None, beta=0.0):
     res = acc_ref[:]
     if alpha != 1.0:
         res = alpha * res
     if out_uplo is not None:
         res = _global_tri_mask(res, r0, c0, out_uplo)
+    if c_ref is not None:
+        # add at the promoted dtype so a wider C keeps its precision (and a
+        # narrower one — the flagship's bf16 Schur operand next to the f32
+        # accumulator — is promoted into it), matching the unfused AB+beta*C
+        ct = c_ref[:]
+        add_dtype = jnp.promote_types(res.dtype, ct.dtype)
+        res = res.astype(add_dtype) + beta * ct.astype(add_dtype)
     out_ref[:] = res.astype(out_ref.dtype)
 
 
@@ -395,6 +403,9 @@ def tri_matmul(
     b_view: tuple[int, int, int, int] | None = None,
     out: jnp.ndarray | None = None,
     out_off: tuple[int, int] = (0, 0),
+    c: jnp.ndarray | None = None,
+    c_view: tuple[int, int, int, int] | None = None,
+    beta: float = 0.0,
 ) -> jnp.ndarray:
     """C = alpha * op(A) @ op(B) with dead blocks of triangular operands /
     results never visited.  See module docstring.
@@ -418,19 +429,31 @@ def tri_matmul(
 
     Views require every window size/offset to be divisible by a viable block
     size (>= 128); otherwise the call transparently falls back to
-    materializing the windows (and a dynamic_update_slice for `out`)."""
+    materializing the windows (and a dynamic_update_slice for `out`).
+
+    c/c_view/beta (tri-output path only): accumulate `beta * C-window` into
+    the live triangle at flush time, inside the kernel — the fused form of
+    syrk's beta*C term (one C-tile read per live output tile instead of a
+    full-matrix slice + add + mask pass downstream; ~3 HBM passes saved per
+    call at cholinv's Schur sizes).  With beta != 0 the dead triangle of the
+    result is UNDEFINED (live tiles are the only ones visited; on the
+    misaligned materializing fallback it happens to hold beta*C) — callers
+    must read only the out_uplo triangle."""
     if a_uplo is not None and b_uplo is not None:
         raise ValueError("at most one triangular operand")
     if out_uplo is not None and (a_uplo is not None or b_uplo is not None):
         raise ValueError("out_uplo cannot combine with a triangular operand")
     if out_uplo is not None and out is not None:
         raise ValueError("in-place `out` is not supported with out_uplo")
+    if beta != 0.0 and (out_uplo is None or c is None):
+        raise ValueError("beta accumulation needs out_uplo and the C operand")
     if interpret is None:
         interpret = _interpret_default()
     if vmem_limit is None and not interpret:
         vmem_limit = _device_budget()[1]
 
     has_view = a_view is not None or b_view is not None or out is not None
+    cr0, cc0 = (c_view[0], c_view[1]) if c_view is not None else (0, 0)
     ar0, ac0, arr, acc_ = a_view if a_view is not None else (0, 0, *A.shape)
     br0, bc0, brr, bcc = b_view if b_view is not None else (0, 0, *B.shape)
     (am, ak) = (acc_, arr) if a_trans else (arr, acc_)
@@ -440,6 +463,12 @@ def tri_matmul(
             f"contraction mismatch: {(am, ak)} x {(bkd, bnd)} "
             f"(A{A.shape} view {a_view}, B{B.shape} view {b_view})"
         )
+    if beta != 0.0 and c is not None:
+        c_dims = (c_view[2], c_view[3]) if c_view is not None else c.shape
+        if c_dims != (am, bnd):
+            raise ValueError(
+                f"C operand {c_dims} does not match the {(am, bnd)} result"
+            )
 
     bm, bn, bk = blocks or default_blocks(
         am, ak, bnd,
@@ -447,15 +476,18 @@ def tri_matmul(
         tri_operand=(a_uplo is not None or b_uplo is not None),
     )
 
-    if has_view:
+    fused_c = beta != 0.0 and c is not None
+    if has_view or fused_c:
         # no padding possible on views: blocks must divide every window
         # size and offset exactly, else materialize and retry
         bm = _fit_block(bm, am, ac0 if a_trans else ar0,
-                        out_off[0] if out is not None else 0)
+                        out_off[0] if out is not None else 0,
+                        cr0 if fused_c else 0)
         bk = _fit_block(bk, ak, ar0 if a_trans else ac0,
                         bc0 if b_trans else br0)
         bn = _fit_block(bn, bnd, br0 if b_trans else bc0,
-                        out_off[1] if out is not None else 0)
+                        out_off[1] if out is not None else 0,
+                        cc0 if fused_c else 0)
         if min(bm, bn, bk) == 0:
             Am = A if a_view is None else _window(A, a_view)
             Bm = B if b_view is None else _window(B, b_view)
@@ -464,6 +496,9 @@ def tri_matmul(
                 b_trans=b_trans, out_uplo=out_uplo, alpha=alpha, blocks=blocks,
                 interpret=interpret, vmem_limit=vmem_limit, precision=precision,
             )
+            if fused_c:
+                Cw = c if c_view is None else _window(c, c_view)
+                res = res + beta * Cw  # jnp promotion: agrees with mode='xla'
             if out is not None:
                 return lax.dynamic_update_slice(out, res.astype(out.dtype), out_off)
             return res
@@ -477,7 +512,14 @@ def tri_matmul(
         Bp = jnp.pad(B, ((0, pb[0]), (0, pb[1]))) if any(pb) else B
 
     nm, nk, nn = M // bm, K // bk, N // bn
-    out_dtype = out.dtype if out is not None else jnp.result_type(A, B)
+    if out is not None:
+        out_dtype = out.dtype
+    elif fused_c:
+        # C participates in the result: promote like the unfused `AB + beta*C`
+        # would, so the fused path agrees with mode='xla' on mixed dtypes
+        out_dtype = jnp.result_type(A, B, c)
+    else:
+        out_dtype = jnp.result_type(A, B)
     acc_dtype = jnp.promote_types(jnp.result_type(A, B), jnp.float32)
     if jnp.dtype(acc_dtype).itemsize > 4 and jax.default_backend() == "tpu":
         acc_dtype = jnp.float32
@@ -580,8 +622,10 @@ def tri_matmul(
         ]
         io = jnp.asarray(np.array([p[0] for p in pairs], np.int32))
         jo = jnp.asarray(np.array([p[1] for p in pairs], np.int32))
+        oc = (cr0 // bm, cc0 // bn)
 
-        def syrk_kernel(io_ref, jo_ref, a_ref, b_ref, out_ref, acc_ref):
+        def syrk_kernel(io_ref, jo_ref, a_ref, b_ref, *rest):
+            out_ref, acc_ref = rest[-2], rest[-1]
             p, k = pl.program_id(0), pl.program_id(1)
             i, j = io_ref[p], jo_ref[p]
 
@@ -593,27 +637,43 @@ def tri_matmul(
 
             @pl.when(k == nk - 1)
             def _():
-                _flush(acc_ref, out_ref, alpha, out_uplo, i * bm, j * bn)
+                _flush(
+                    acc_ref, out_ref, alpha, out_uplo, i * bm, j * bn,
+                    c_ref=rest[0] if fused_c else None, beta=beta,
+                )
 
+        in_specs = [
+            pl.BlockSpec(
+                a_shape,
+                (lambda p, k, io, jo: (k + oa[0], io[p] + oa[1]))
+                if a_trans
+                else (lambda p, k, io, jo: (io[p] + oa[0], k + oa[1])),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                b_shape,
+                (lambda p, k, io, jo: (jo[p] + ob[0], k + ob[1]))
+                if b_trans
+                else (lambda p, k, io, jo: (k + ob[0], jo[p] + ob[1])),
+                memory_space=pltpu.VMEM,
+            ),
+        ]
+        operands = [io, jo, Ap, Bp]
+        if fused_c:
+            # C tile fetched once per output tile (index map ignores k, so
+            # consecutive k-steps revisit the same block without re-DMA)
+            in_specs.append(
+                pl.BlockSpec(
+                    (bm, bn),
+                    lambda p, k, io, jo: (io[p] + oc[0], jo[p] + oc[1]),
+                    memory_space=pltpu.VMEM,
+                )
+            )
+            operands.append(c)
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(len(pairs), nk),
-            in_specs=[
-                pl.BlockSpec(
-                    a_shape,
-                    (lambda p, k, io, jo: (k + oa[0], io[p] + oa[1]))
-                    if a_trans
-                    else (lambda p, k, io, jo: (io[p] + oa[0], k + oa[1])),
-                    memory_space=pltpu.VMEM,
-                ),
-                pl.BlockSpec(
-                    b_shape,
-                    (lambda p, k, io, jo: (jo[p] + ob[0], k + ob[1]))
-                    if b_trans
-                    else (lambda p, k, io, jo: (k + ob[0], jo[p] + ob[1])),
-                    memory_space=pltpu.VMEM,
-                ),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec(
                 (bm, bn), lambda p, k, io, jo: (io[p], jo[p]), memory_space=pltpu.VMEM
             ),
@@ -629,11 +689,14 @@ def tri_matmul(
                 dimension_semantics=("arbitrary", "arbitrary"),
                 vmem_limit_bytes=vmem_limit,
             ),
-        )(io, jo, Ap, Bp)
-        # tiles in the dead half are never written by the kernel; Mosaic
-        # zero-initializes outputs only per-visited-block, so blank the dead
-        # half explicitly (cheap elementwise, fuses with the crop below)
-        res = _global_tri_mask(res, 0, 0, out_uplo)
+        )(*operands)
+        if not fused_c:
+            # tiles in the dead half are never written by the kernel; Mosaic
+            # zero-initializes outputs only per-visited-block, so blank the
+            # dead half explicitly (cheap elementwise, fuses with the crop
+            # below).  With fused beta*C the dead half stays UNDEFINED by
+            # contract — no full-matrix mask pass.
+            res = _global_tri_mask(res, 0, 0, out_uplo)
 
     else:
         # ---- tri-operand (trmm): enumerate live (tile-row, k) pairs ------

@@ -287,17 +287,20 @@ def syrk(
     In 'xla'/'explicit' modes the full dense symmetric result is computed
     (MXU-friendly); callers that need only a triangle mask the output.
     mode='pallas' (single-device grid) instead honors args.uplo: only that
-    triangle of the *product* is live — the dead half carries zeros plus the
-    unmasked beta*C term — so callers must read only the args.uplo triangle
-    (models/cholesky.py symmetrizes its base-case panel from 'U').
+    triangle of the result is valid — with beta=0 the dead half is zeroed,
+    with beta!=0 it is UNDEFINED (the fused in-kernel beta*C accumulate
+    never visits dead tiles) — so callers must read only the args.uplo
+    triangle (models/cholesky.py symmetrizes its base-case panel from 'U').
     """
     if args.beta != 0.0 and C is None:
         raise ValueError("beta != 0 requires the accumulate operand C")
     if mode == "pallas" and grid.num_devices == 1:
         # mode='pallas' honors args.uplo: only that triangle of the product
-        # is computed (dead half zeros, so `beta*C` survives unmasked
-        # there); skipping the symmetric redundancy is where the ~1.65x
-        # comes from.  Callers must read only the live triangle
+        # is computed; skipping the symmetric redundancy is where the ~1.65x
+        # comes from.  beta*C accumulates INSIDE the kernel at flush time
+        # (one C-tile read per live output tile instead of a full-matrix
+        # slice + add downstream), which leaves the dead half UNDEFINED when
+        # beta != 0 — callers must read only the args.uplo triangle
         # (models/cholesky.py symmetrizes its base-case panel from 'U').
         a_dims = (a_view[2], a_view[3]) if a_view is not None else A.shape
         n_out = a_dims[1] if args.trans else a_dims[0]
@@ -306,15 +309,13 @@ def syrk(
             grid, n_out, n_out, k_in, jnp.result_type(A)
         )
         tracing.emit(flops=flops / 2, comm_bytes=comm, collectives=ncoll)
-        out = pallas_tpu.tri_matmul(
+        return pallas_tpu.tri_matmul(
             A, A,
             a_trans=args.trans, b_trans=not args.trans,
             out_uplo=args.uplo, alpha=args.alpha, precision=args.precision,
             a_view=a_view, b_view=a_view,
+            c=C, c_view=c_view, beta=args.beta,
         )
-        if args.beta != 0.0:
-            out = out + args.beta * _take_view(C, c_view)
-        return out
     Aw = _take_view(A, a_view)
     Aop = (Aw.T, Aw) if args.trans else (Aw, Aw.T)
     out = _matmul(grid, Aop[0], Aop[1], mode, args.precision)

@@ -16,6 +16,13 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+# Validation matmuls run at precision='highest' unconditionally: on TPU the
+# default f32 matmul uses bf16-grade MXU passes, which floors the measurable
+# residual near 1e-4 and would mask a genuinely bad factor (observed: a
+# correct n=1024 f32 factor 'failing' at 4.6e-4 purely from the gate's own
+# product).  Gates are not on the timed path; full precision is free here.
+_PREC = "highest"
+
 
 def rel_fro(err: jnp.ndarray, ref: jnp.ndarray) -> jnp.ndarray:
     """sqrt(sum(err^2)) / sqrt(sum(ref^2)) — reference util::residual_local
@@ -33,7 +40,7 @@ def cholesky_residual(A: jnp.ndarray, R: jnp.ndarray) -> jnp.ndarray:
     plain jnp.dot: under jit with sharded operands XLA plans the same
     distributed contraction.
     """
-    return rel_fro(A - R.T @ R, A)
+    return rel_fro(A - jnp.matmul(R.T, R, precision=_PREC), A)
 
 
 def cholesky_inverse_residual(R: jnp.ndarray, Rinv: jnp.ndarray) -> jnp.ndarray:
@@ -41,7 +48,7 @@ def cholesky_inverse_residual(R: jnp.ndarray, Rinv: jnp.ndarray) -> jnp.ndarray:
     (util.hpp:3-23)."""
     n = R.shape[0]
     eye = jnp.eye(n, dtype=R.dtype)
-    return rel_fro(eye - R @ Rinv, eye)
+    return rel_fro(eye - jnp.matmul(R, Rinv, precision=_PREC), eye)
 
 
 def qr_orthogonality(Q: jnp.ndarray) -> jnp.ndarray:
@@ -49,13 +56,13 @@ def qr_orthogonality(Q: jnp.ndarray) -> jnp.ndarray:
     (test/qr/validate.hpp:7-32)."""
     n = Q.shape[1]
     eye = jnp.eye(n, dtype=Q.dtype)
-    return rel_fro(eye - Q.T @ Q, eye)
+    return rel_fro(eye - jnp.matmul(Q.T, Q, precision=_PREC), eye)
 
 
 def qr_residual(A: jnp.ndarray, Q: jnp.ndarray, R: jnp.ndarray) -> jnp.ndarray:
     """‖A − QR‖_F / ‖A‖_F — reference qr::validate::residual
     (test/qr/validate.hpp:37-52)."""
-    return rel_fro(A - Q @ R, A)
+    return rel_fro(A - jnp.matmul(Q, R, precision=_PREC), A)
 
 
 def inverse_residual(A: jnp.ndarray, Ainv: jnp.ndarray) -> jnp.ndarray:
@@ -63,4 +70,4 @@ def inverse_residual(A: jnp.ndarray, Ainv: jnp.ndarray) -> jnp.ndarray:
     (that file is bit-rotted upstream; this is the working equivalent)."""
     n = A.shape[0]
     eye = jnp.eye(n, dtype=A.dtype)
-    return rel_fro(eye - A @ Ainv, eye)
+    return rel_fro(eye - jnp.matmul(A, Ainv, precision=_PREC), eye)

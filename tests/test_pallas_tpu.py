@@ -117,17 +117,65 @@ def test_summa_trmm_pallas_mode(grid1, mats):
     _close(out, jnp.triu(A).T @ B)
 
 
-def test_summa_syrk_pallas_mode_keeps_beta_dense(grid1, mats):
+def test_summa_syrk_pallas_mode_fused_beta(grid1, mats):
+    """beta*C accumulates inside the kernel: the live (uplo) triangle carries
+    alpha*AᵀA + beta*C; the dead half is UNDEFINED by contract (callers read
+    only the live triangle — cholinv's Schur consumer does)."""
     A, B, _ = mats
     C0 = jnp.asarray(np.random.default_rng(1).standard_normal((B.shape[1],) * 2))
     out = summa.syrk(
         grid1, B, C0, summa.SyrkArgs(trans=True, alpha=-1.0, beta=1.0),
         mode="pallas",
     )
-    want_upper = jnp.triu(-(B.T @ B)) + C0
-    # live triangle: product + beta*C; dead half: beta*C only
-    _close(jnp.triu(out), jnp.triu(want_upper), tol=1e-9)
-    _close(jnp.tril(out, k=-1), jnp.tril(C0, k=-1))
+    want_upper = jnp.triu(-(B.T @ B) + C0)
+    _close(jnp.triu(out), want_upper, tol=1e-9)
+
+
+def test_tri_matmul_fused_beta_views():
+    """Aligned in-kernel beta*C with every operand a window of a larger
+    buffer — the exact shape of cholinv's Schur update at 128-multiples."""
+    rng = np.random.default_rng(2)
+    buf = jnp.asarray(rng.standard_normal((512, 512)))
+    Rp = jnp.asarray(rng.standard_normal((512, 512)))
+    got = tri_matmul(
+        Rp, Rp, a_trans=True, b_trans=False, out_uplo="U", alpha=-1.0,
+        a_view=(128, 256, 128, 256), b_view=(128, 256, 128, 256),
+        c=buf, c_view=(256, 256, 256, 256), beta=1.0,
+        blocks=(128, 128, 128),  # multi-tile: 2x2 output, 3 live tiles
+    )
+    R12 = Rp[128:256, 256:512]
+    want = jnp.triu(-(R12.T @ R12) + buf[256:512, 256:512])
+    _close(jnp.triu(got), want)
+    # misaligned windows fall back to materializing but keep the same live
+    # triangle
+    got2 = tri_matmul(
+        Rp, Rp, a_trans=True, b_trans=False, out_uplo="U", alpha=-1.0,
+        a_view=(100, 200, 100, 200), b_view=(100, 200, 100, 200),
+        c=buf, c_view=(200, 200, 200, 200), beta=1.0,
+    )
+    R12m = Rp[100:200, 200:400]
+    wantm = jnp.triu(-(R12m.T @ R12m) + buf[200:400, 200:400])
+    _close(jnp.triu(got2), wantm)
+
+
+def test_tri_matmul_fused_beta_promotes_c_dtype():
+    """Mixed dtypes: a wider C promotes the result exactly like the unfused
+    `AB + beta*C` (mode='xla') would — on the aligned kernel path and the
+    misaligned fallback alike."""
+    rng = np.random.default_rng(3)
+    A = jnp.asarray(rng.standard_normal((256, 256)), jnp.bfloat16)
+    C = jnp.asarray(rng.standard_normal((256, 256)), jnp.float32)
+    got = tri_matmul(A, A, a_trans=True, out_uplo="U", c=C, beta=1.0)
+    assert got.dtype == jnp.float32
+    want = jnp.triu(
+        A.astype(jnp.float32).T @ A.astype(jnp.float32) + C
+    )
+    _close(jnp.triu(got), want, tol=1e-1)  # bf16 operand precision
+    got2 = tri_matmul(
+        A[:200, :200], A[:200, :200], a_trans=True, out_uplo="U",
+        c=C[:200, :200], beta=1.0,
+    )
+    assert got2.dtype == jnp.float32
 
 
 def test_cholinv_pallas_mode_end_to_end(grid1):

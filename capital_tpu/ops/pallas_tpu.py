@@ -106,17 +106,34 @@ def default_blocks(
     the raised vmem_limit on big-tile chips, ~10MB of scoped VMEM on the
     conservative ones).
 
-    tri_operand is accepted for call-site symmetry but currently does not
-    change the choice: at 8192^2 bf16 on v5e (80-iteration in-jit timing),
-    deep K wins for every kernel shape — dense 193 vs 176 TF/s, trmm 152 vs
-    139 useful, syrk 144 vs 134 at bk=2048 vs 1024.  trmm's remaining gap to
-    dense is exactly the masked half-tiles of the bk/2-wide diagonal band
-    (live-pair fraction x dense time predicts the measurement within 2%), so
-    finer K trades that band against dense efficiency and loses."""
+    tri_operand halves the K depth (bk=1024 bf16 / 512 f32): a triangular
+    operand's masked diagonal band is bk wide, so its wasted half-tiles cost
+    ~bk/n1 of the useful flops, and inside cholinv most trmm windows are
+    small enough that the band dominates.  Device-trace totals over the full
+    n=16384 factor (v5e, per-kernel own time): CI kernels 21.27 ms/iter at
+    bk=2048, 19.77 at bk=512, 19.46 at bk=1024 — the band saving beats the
+    deep-K dense-efficiency loss at 1024 but not 512.  (Standalone 8192^2
+    single-kernel timings preferred deep K — dense 193 vs 176 TF/s, trmm 152
+    vs 139 — which is why this was previously left uniform; the standalone
+    shape under-weights the small-window kernels where the band bites.  A
+    two-phase band/bulk split at fine tiles was also tried and rejected: the
+    masked single-phase kernel already sustains ~185 TF/s on executed flops,
+    fine 512 band tiles only reach ~120, and the bulk phase's aliased
+    read-accumulate forced XLA to copy the full buffer once per self-update
+    call — 7 x 1.63 ms/iter at n=16k.)
+
+    The standalone-vs-in-context conflict at the 8192 window is unresolved
+    (same shape, opposite winner); the default follows the in-context
+    numbers because the recursion is the framework's only pallas-mode trmm
+    consumer (rectri/trsm default to mode='xla').  Callers with one big
+    standalone triangular product can pass blocks=(bm, bn, 2048) to get the
+    deep-K configuration back."""
     cap, _ = _device_budget()
     bm = max(128, min(cap, _round_up(m, 128)))
     bn = max(128, min(cap, _round_up(n, 128)))
     dtype_bk = 2048 if itemsize <= 2 else 1024
+    if tri_operand:
+        dtype_bk //= 2
     bk = max(128, min(dtype_bk, _round_up(k, 128)))
     return bm, bn, bk
 

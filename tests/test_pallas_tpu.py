@@ -176,6 +176,9 @@ def test_tri_matmul_fused_beta_promotes_c_dtype():
         c=C[:200, :200], beta=1.0,
     )
     assert got2.dtype == jnp.float32
+    Af = A[:200, :200].astype(jnp.float32)
+    want2 = jnp.triu(Af.T @ Af + C[:200, :200])
+    _close(jnp.triu(got2), want2, tol=1e-1)
 
 
 def test_cholinv_pallas_mode_end_to_end(grid1):

@@ -165,20 +165,23 @@ def test_tri_matmul_fused_beta_promotes_c_dtype():
     rng = np.random.default_rng(3)
     A = jnp.asarray(rng.standard_normal((256, 256)), jnp.bfloat16)
     C = jnp.asarray(rng.standard_normal((256, 256)), jnp.float32)
+    # the aligned kernel path adds C onto the f32 accumulator (slightly
+    # BETTER than unfused); the misaligned fallback first rounds the product
+    # to the operands' bf16 — exactly what unfused mode='xla' produces.
+    # Each path gets its own bit-matched reference so tolerances stay tight.
+    def product(a):
+        return jnp.matmul(a.T, a, preferred_element_type=jnp.float32)
+
     got = tri_matmul(A, A, a_trans=True, out_uplo="U", c=C, beta=1.0)
     assert got.dtype == jnp.float32
-    want = jnp.triu(
-        A.astype(jnp.float32).T @ A.astype(jnp.float32) + C
-    )
-    _close(jnp.triu(got), want, tol=1e-1)  # bf16 operand precision
+    _close(jnp.triu(got), jnp.triu(product(A) + C), tol=1e-3)
     got2 = tri_matmul(
         A[:200, :200], A[:200, :200], a_trans=True, out_uplo="U",
         c=C[:200, :200], beta=1.0,
     )
     assert got2.dtype == jnp.float32
-    Af = A[:200, :200].astype(jnp.float32)
-    want2 = jnp.triu(Af.T @ Af + C[:200, :200])
-    _close(jnp.triu(got2), want2, tol=1e-1)
+    want2 = product(A[:200, :200]).astype(jnp.bfloat16).astype(jnp.float32)
+    _close(jnp.triu(got2), jnp.triu(want2 + C[:200, :200]), tol=1e-3)
 
 
 def test_cholinv_pallas_mode_end_to_end(grid1):

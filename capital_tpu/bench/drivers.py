@@ -65,6 +65,20 @@ def _spd(n: int, dtype, seed: int = 0) -> jnp.ndarray:
     return jax.block_until_ready(make(jax.random.key(seed)))
 
 
+def _resolve_mode(mode: str, grid: Grid) -> str:
+    """'auto' picks the best SUMMA mode for the topology: the
+    dead-block-skipping pallas kernels on a single TPU (the flagship
+    bench.py path — mode='xla' leaves ~40% of cholinv throughput on the
+    table there), GSPMD planning on a mesh (pallas is single-device-only
+    and would silently fall back anyway).  Off-TPU, pallas means the
+    interpreter — orders of magnitude slower than xla — so the CPU smoke
+    rig stays on xla."""
+    if mode != "auto":
+        return mode
+    one_tpu = grid.num_devices == 1 and jax.default_backend() == "tpu"
+    return "pallas" if one_tpu else "xla"
+
+
 def _grid(args) -> Grid:
     """Largest d x d x c grid the device set supports, preferring the
     requested replication depth c (reference rep_div knob,
@@ -96,7 +110,7 @@ def cholinv(args) -> dict:
         complete_inv=not args.no_complete_inv,
         split=args.split,
         base_case_dim=args.bc,
-        mode=args.mode,
+        mode=_resolve_mode(args.mode, grid),
         precision=None if dtype.itemsize < 4 else "highest",
     )
     A = _spd(args.n, dtype)
@@ -174,13 +188,14 @@ def cacqr(args) -> dict:
 
 def summa_gemm(args) -> dict:
     grid = _grid(args)
+    mode = _resolve_mode(args.mode, grid)
     dtype = jnp.dtype(args.dtype)
     A = jax.random.normal(jax.random.key(0), (args.m, args.k), dtype)
     B = jax.random.normal(jax.random.key(1), (args.k, args.n), dtype)
     gargs = summa.GemmArgs(precision=None if dtype.itemsize < 4 else "highest")
 
     def step(a):
-        return summa.gemm(grid, a, B, args=gargs, mode=args.mode)
+        return summa.gemm(grid, a, B, args=gargs, mode=mode)
 
     # carry must match operand shape: square M=N=K benches only need A
     if not (args.m == args.n == args.k):
@@ -188,10 +203,10 @@ def summa_gemm(args) -> dict:
     t = harness.timed_loop(step, A, iters=args.iters)
     rec = harness.report(
         "summa_gemm_tflops", t, 2.0 * args.m * args.n * args.k, dtype,
-        m=args.m, n=args.n, k=args.k, grid=repr(grid), mode=args.mode,
+        m=args.m, n=args.n, k=args.k, grid=repr(grid), mode=mode,
     )
     if args.validate:
-        C = jax.jit(lambda a: summa.gemm(grid, a, B, args=gargs, mode=args.mode))(A)
+        C = jax.jit(lambda a: summa.gemm(grid, a, B, args=gargs, mode=mode))(A)
         ref = jnp.matmul(A.astype(jnp.float32), B.astype(jnp.float32))
         err = float(residual.rel_fro(C.astype(jnp.float32) - ref, ref))
         _gate("gemm_residual", err, _tolerance(dtype))
@@ -254,7 +269,7 @@ def spd_inverse(args) -> dict:
     grid = _grid(args)
     dtype = jnp.dtype(args.dtype)
     cfg = cholesky.CholinvConfig(
-        base_case_dim=args.bc, mode=args.mode,
+        base_case_dim=args.bc, mode=_resolve_mode(args.mode, grid),
         precision=None if dtype.itemsize < 4 else "highest",
     )
     A = _spd(args.n, dtype)
@@ -295,7 +310,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--iters", type=int, default=3)
     p.add_argument("--bc", type=int, default=512, help="base-case dim")
     p.add_argument("--split", type=int, default=1)
-    p.add_argument("--mode", default="xla", choices=["xla", "explicit", "pallas"])
+    p.add_argument(
+        "--mode", default="auto", choices=["auto", "xla", "explicit", "pallas"],
+        help="SUMMA mode; auto = pallas on one device, xla on a mesh",
+    )
     p.add_argument("--variant", type=int, default=2, help="1=CQR, 2=CQR2")
     p.add_argument("--regime", default="auto", choices=["auto", "1d", "dist"])
     p.add_argument("--c", type=int, default=1, help="replication depth")

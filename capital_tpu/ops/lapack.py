@@ -24,29 +24,50 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def _compute_dtype(dtype):
+    """Panel factorizations run at >= f32: sub-f32 inputs (bf16/f16) are the
+    numerically fragile case for potrf/trtri (cholinv's base_case_dtype
+    principle, models/cholesky.py), and the CPU backend's LAPACK custom
+    calls reject them outright — observed as NotImplementedError from a bf16
+    gram in cacqr's 1d sweep on the test rig.  Results cast back to the
+    input dtype."""
+    return jnp.float32 if jnp.dtype(dtype).itemsize < 4 else jnp.dtype(dtype)
+
+
 def potrf(A: jnp.ndarray, uplo: str = "U") -> jnp.ndarray:
     """Cholesky factor of SPD A: upper R with A = RᵀR (uplo='U') or lower L
     with A = LLᵀ (uplo='L').  Reference lapack::engine::_potrf
     (interface.hpp:30-44)."""
-    L = lax.linalg.cholesky(A)
+    L = lax.linalg.cholesky(A.astype(_compute_dtype(A.dtype)))
+    L = L.astype(A.dtype)
     return L.T if uplo == "U" else L
 
 
 def trtri(T: jnp.ndarray, uplo: str = "U", unit_diag: bool = False) -> jnp.ndarray:
     """Inverse of a triangular matrix.  Reference lapack::engine::_trtri
     (interface.hpp:46-59)."""
-    eye = jnp.eye(T.shape[-1], dtype=T.dtype)
-    return lax.linalg.triangular_solve(
-        T, eye, left_side=True, lower=(uplo == "L"), unit_diagonal=unit_diag
+    ct = _compute_dtype(T.dtype)
+    eye = jnp.eye(T.shape[-1], dtype=ct)
+    out = lax.linalg.triangular_solve(
+        T.astype(ct), eye, left_side=True, lower=(uplo == "L"),
+        unit_diagonal=unit_diag,
     )
+    return out.astype(T.dtype)
 
 
 def potrf_trtri(A: jnp.ndarray, uplo: str = "U") -> tuple[jnp.ndarray, jnp.ndarray]:
     """Fused base-case pair: factor + triangular inverse in one call — the
     reference base case always computes both back to back
-    (cholinv policy.h:197-201)."""
-    R = potrf(A, uplo)
-    return R, trtri(R, uplo)
+    (cholinv policy.h:197-201).  The factor stays at the compute dtype
+    between the two steps (no intermediate downcast)."""
+    ct = _compute_dtype(A.dtype)
+    L = lax.linalg.cholesky(A.astype(ct))
+    T = L.T if uplo == "U" else L
+    eye = jnp.eye(A.shape[-1], dtype=ct)
+    Tinv = lax.linalg.triangular_solve(
+        T, eye, left_side=True, lower=(uplo == "L")
+    )
+    return T.astype(A.dtype), Tinv.astype(A.dtype)
 
 
 def potrf_trtri_upper(P: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
@@ -65,12 +86,13 @@ def potrf_trtri_upper(P: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     transposes stay panel-sized."""
     from capital_tpu.ops import pallas_tpu
 
-    P_low = pallas_tpu.transpose(P, out_uplo="L")
+    ct = _compute_dtype(P.dtype)
+    P_low = pallas_tpu.transpose(P, out_uplo="L", out_dtype=ct)
     L = lax.linalg.cholesky(P_low, symmetrize_input=False)
-    eye = jnp.eye(P.shape[-1], dtype=P.dtype)
+    eye = jnp.eye(P.shape[-1], dtype=ct)
     Linv = lax.linalg.triangular_solve(L, eye, left_side=True, lower=True)
-    R = pallas_tpu.transpose(L, out_uplo="U")
-    Rinv = pallas_tpu.transpose(Linv, out_uplo="U")
+    R = pallas_tpu.transpose(L, out_uplo="U", out_dtype=P.dtype)
+    Rinv = pallas_tpu.transpose(Linv, out_uplo="U", out_dtype=P.dtype)
     return R, Rinv
 
 
@@ -78,9 +100,10 @@ def geqrf(A: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Householder QR returning (Q, R) — the combined geqrf+orgqr capability
     (reference interface.hpp:61-89; upstream never calls these, see
     SURVEY §2 row 9)."""
-    return jnp.linalg.qr(A, mode="reduced")
+    Q, R = jnp.linalg.qr(A.astype(_compute_dtype(A.dtype)), mode="reduced")
+    return Q.astype(A.dtype), R.astype(A.dtype)
 
 
 def orgqr(A: jnp.ndarray) -> jnp.ndarray:
     """Explicit Q from a Householder factorization (parity wrapper)."""
-    return jnp.linalg.qr(A, mode="reduced")[0]
+    return geqrf(A)[0]

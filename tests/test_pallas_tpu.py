@@ -181,7 +181,14 @@ def test_tri_matmul_fused_beta_promotes_c_dtype():
     )
     assert got2.dtype == jnp.float32
     want2 = product(A[:200, :200]).astype(jnp.bfloat16).astype(jnp.float32)
-    _close(jnp.triu(got2), jnp.triu(want2 + C[:200, :200]), tol=1e-3)
+    # the kernel's blocked f32 accumulation and jnp.matmul's order can land
+    # on opposite sides of a bf16 rounding boundary, so individual entries
+    # may differ by one ulp (~1.0 at these ~200 magnitudes); boundary hits
+    # are rare, so the MEAN stays tiny unless the beta*C term or a window
+    # is actually wrong (dropping C would shift the mean by ~0.8)
+    diff = jnp.abs(jnp.triu(got2) - jnp.triu(want2 + C[:200, :200]))
+    assert float(jnp.max(diff)) < 1.5
+    assert float(jnp.mean(diff)) < 0.01
 
 
 def test_cholinv_pallas_mode_end_to_end(grid1):

@@ -65,6 +65,12 @@ def _spd(n: int, dtype, seed: int = 0) -> jnp.ndarray:
     return jax.block_until_ready(make(jax.random.key(seed)))
 
 
+def _knobs(args) -> dict:
+    """Topology knobs echoed into every JSON record so sweep rows over
+    --layout/--chunks stay attributable to the config that produced them."""
+    return dict(layout=getattr(args, "layout", 0), chunks=getattr(args, "chunks", 0))
+
+
 def _resolve_mode(mode: str, grid: Grid) -> str:
     """'auto' picks the best SUMMA mode for the topology: the
     dead-block-skipping pallas kernels on a single TPU (the flagship
@@ -86,9 +92,11 @@ def _grid(args) -> Grid:
     dev = jax.devices()
     if args.devices:
         dev = dev[: args.devices]
+    layout = getattr(args, "layout", 0)
+    chunks = getattr(args, "chunks", 0)
     n = len(dev)
     if n == 1:
-        return Grid.square(c=1, devices=dev)
+        return Grid.square(c=1, devices=dev, num_chunks=chunks)
     best = (1, 1)  # (d, c)
     for c in (args.c, 1, 2, 4, 8):
         d = 1
@@ -97,7 +105,9 @@ def _grid(args) -> Grid:
         if d * d * c <= n and d * d * c > best[0] ** 2 * best[1]:
             best = (d, c)
     d, c = best
-    return Grid.square(c=c, devices=dev[: d * d * c])
+    return Grid.square(
+        c=c, devices=dev[: d * d * c], layout=layout, num_chunks=chunks
+    )
 
 
 # --------------------------------------------------------------------------
@@ -122,7 +132,8 @@ def cholinv(args) -> dict:
     t = harness.timed_loop(step, A, iters=args.iters)
     flops = 2.0 * args.n**3 / 3.0  # factor n³/3 + triangular inverse n³/3
     rec = harness.report(
-        "cholinv_tflops", t, flops, dtype, n=args.n, grid=repr(grid), bc=args.bc
+        "cholinv_tflops", t, flops, dtype, n=args.n, grid=repr(grid), bc=args.bc,
+        **_knobs(args),
     )
     if args.validate:
         R, Rinv = jax.jit(lambda a: cholesky.factor(grid, a, cfg))(A)
@@ -176,7 +187,7 @@ def cacqr(args) -> dict:
     flops = 2.0 * args.m * args.n**2 * cfg.num_iter
     rec = harness.report(
         "cacqr_tflops", t, flops, dtype, m=args.m, n=args.n,
-        variant=args.variant, grid=repr(grid),
+        variant=args.variant, grid=repr(grid), **_knobs(args),
     )
     if args.validate:
         Q, R = jax.jit(lambda a: qr.factor(grid, a, cfg))(A)
@@ -204,6 +215,7 @@ def summa_gemm(args) -> dict:
     rec = harness.report(
         "summa_gemm_tflops", t, 2.0 * args.m * args.n * args.k, dtype,
         m=args.m, n=args.n, k=args.k, grid=repr(grid), mode=mode,
+        **_knobs(args),
     )
     if args.validate:
         C = jax.jit(lambda a: summa.gemm(grid, a, B, args=gargs, mode=mode))(A)
@@ -225,7 +237,8 @@ def rectri(args) -> dict:
 
     t = harness.timed_loop(step, L, iters=args.iters)
     rec = harness.report(
-        "rectri_tflops", t, args.n**3 / 3.0, dtype, n=args.n, grid=repr(grid)
+        "rectri_tflops", t, args.n**3 / 3.0, dtype, n=args.n, grid=repr(grid),
+        **_knobs(args),
     )
     if args.validate:
         Linv = jax.jit(lambda a: inverse.rectri(grid, a, "L", cfg))(L)
@@ -279,7 +292,10 @@ def spd_inverse(args) -> dict:
 
     t = harness.timed_loop(step, A, iters=args.iters)
     flops = 2.0 * args.n**3 / 3.0 + args.n**3 / 3.0
-    rec = harness.report("spd_inverse_tflops", t, flops, dtype, n=args.n, grid=repr(grid))
+    rec = harness.report(
+        "spd_inverse_tflops", t, flops, dtype, n=args.n, grid=repr(grid),
+        **_knobs(args),
+    )
     if args.validate:
         Ainv = jax.jit(lambda a: cholesky.spd_inverse(grid, a, cfg))(A)
         _gate(
@@ -317,6 +333,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--variant", type=int, default=2, help="1=CQR, 2=CQR2")
     p.add_argument("--regime", default="auto", choices=["auto", "1d", "dist"])
     p.add_argument("--c", type=int, default=1, help="replication depth")
+    p.add_argument(
+        "--layout", type=int, default=0, choices=[0, 1, 2],
+        help="device->grid-coordinate layout (reference topology.h:77-123)",
+    )
+    p.add_argument(
+        "--chunks", type=int, default=0,
+        help="explicit-SUMMA bcast pipelining chunks (reference num_chunks)",
+    )
     p.add_argument("--devices", type=int, default=0, help="limit device count")
     p.add_argument("--newton-iters", type=int, default=30)
     p.add_argument("--no-complete-inv", action="store_true")

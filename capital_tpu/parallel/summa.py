@@ -113,6 +113,12 @@ def _explicit_matmul(
     contiguously over the depth axis: layer z handles steps
     [z*d/c, (z+1)*d/c), which is the 2.5D replication trade (topology.h:76-78
     replication depth c).
+
+    With grid.num_chunks > 1 each K-panel's broadcast is further split into
+    that many K-slices — the reference's chunked Ibcast pipeline
+    (summa.hpp:196-215): each slice is an independent collective the
+    latency-hiding scheduler can overlap with the previous slice's local
+    matmul.  The chunk loop is unrolled at trace time (static shapes).
     """
     d, c = grid.dx, grid.c
     if grid.dy != d:
@@ -126,6 +132,12 @@ def _explicit_matmul(
         raise ValueError(f"global dims {(M, K, N)} must be divisible by d={d}")
 
     steps_per_layer = d // c
+    q = max(1, grid.num_chunks)
+    if (K // d) % q:
+        raise ValueError(
+            f"num_chunks={q} must divide the local K panel extent {K // d}"
+        )
+    ck = K // d // q
 
     def kernel(a, b):
         # a: (M/d, K/d) block at (x, y);  b: (K/d, N/d) block at (x, y)
@@ -133,15 +145,19 @@ def _explicit_matmul(
         yi = lax.axis_index("y")
         zi = lax.axis_index("z")
 
-        def body(i, acc):
-            k = zi * steps_per_layer + i
-            a_panel = lax.psum(jnp.where(yi == k, a, jnp.zeros_like(a)), "y")
-            b_panel = lax.psum(jnp.where(xi == k, b, jnp.zeros_like(b)), "x")
-            return acc + jnp.matmul(a_panel, b_panel, precision=precision)
-
         acc = jnp.zeros((a.shape[0], b.shape[1]), dtype=jnp.result_type(a, b))
-        acc = lax.pcast(acc, ("x", "y", "z"), to="varying")  # device-varying carry
-        acc = lax.fori_loop(0, steps_per_layer, body, acc, unroll=True)
+        for i in range(steps_per_layer):
+            k = zi * steps_per_layer + i
+            for ch in range(q):
+                a_sl = a[:, ch * ck : (ch + 1) * ck]
+                b_sl = b[ch * ck : (ch + 1) * ck, :]
+                a_panel = lax.psum(
+                    jnp.where(yi == k, a_sl, jnp.zeros_like(a_sl)), "y"
+                )
+                b_panel = lax.psum(
+                    jnp.where(xi == k, b_sl, jnp.zeros_like(b_sl)), "x"
+                )
+                acc = acc + jnp.matmul(a_panel, b_panel, precision=precision)
         return lax.psum(acc, "z")
 
     return jax.shard_map(

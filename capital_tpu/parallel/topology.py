@@ -62,6 +62,53 @@ def _infer_square_face(num_devices: int, c: int) -> int:
     return d
 
 
+def _order_devices(
+    devices: Sequence[jax.Device], dx: int, dy: int, c: int, layout: int
+) -> np.ndarray:
+    """Assign devices to (x, y, z) grid coordinates — the TPU analog of the
+    reference's rank->coordinate ``layout`` variants (topology.h:77-123).
+
+    On an MPI cluster the layout decides which ranks share a node; on a TPU
+    slice it decides which mesh axes map to adjacent ICI links (device order
+    is physical on real slices), so it is the same locality knob:
+
+      0  depth-fastest (reference layout 0: z = rank % c) — consecutive
+         devices stack along the replication axis, so the depth allreduce
+         rides the shortest links.  The natural reshape.
+      1  face-fastest (reference layout 1 family) — consecutive devices tile
+         the d x d face first; row/column bcasts get the short links, depth
+         gets the long ones.
+      2  subcube blocking (reference layout 2, the 64-rank subcube variant,
+         topology.h:104-123) — consecutive groups of 8 devices form 2x2x2
+         subcubes, balancing all three axes; falls back to layout 0 when any
+         dimension is odd.
+    """
+    dev = np.asarray(devices, dtype=object)
+    if layout == 0:
+        return dev.reshape(dx, dy, c)
+    if layout == 1:
+        return np.moveaxis(dev.reshape(c, dx, dy), 0, 2)
+    if layout == 2:
+        if dx % 2 or dy % 2 or c % 2:
+            import warnings
+
+            warnings.warn(
+                f"layout=2 needs even grid dims, got {(dx, dy, c)}: "
+                "falling back to layout 0 (a layout-0-vs-2 comparison on "
+                "this grid would silently measure the same ordering)",
+                stacklevel=3,
+            )
+            return dev.reshape(dx, dy, c)
+        # consecutive groups of 8 devices form 2x2x2 subcubes, block-major
+        # over the (dx/2, dy/2, c/2) grid of subcubes
+        return (
+            dev.reshape(dx // 2, dy // 2, c // 2, 2, 2, 2)
+            .transpose(0, 3, 1, 4, 2, 5)
+            .reshape(dx, dy, c)
+        )
+    raise ValueError(f"layout must be 0, 1, or 2, got {layout}")
+
+
 @dataclasses.dataclass(frozen=True)
 class Grid:
     """A d x d x c (or dx x dy x c) device grid backed by a jax Mesh.
@@ -73,26 +120,40 @@ class Grid:
       mesh: Mesh with axes ('x', 'y', 'z') of shape (dx, dy, c).
       c:    replication depth (the 'z' axis extent) — trades memory for
             communication exactly like the reference's rep_factor.
+      num_chunks: SUMMA communication-pipelining granularity, carried on the
+            topology exactly like the reference's ctor argument
+            (topo::square(world, c, layout, num_chunks), topology.h:67):
+            the explicit schedule splits each K-panel broadcast into this
+            many slices so the compiler can overlap each slice's collective
+            with the previous slice's local matmul (the Ibcast/Iallreduce
+            pipeline of summa.hpp:196-215).  0/1 = unchunked.
     """
 
     mesh: Mesh
+    num_chunks: int = 0
 
     # ---- constructors ------------------------------------------------------
 
     @staticmethod
-    def square(c: int = 1, devices: Optional[Sequence[jax.Device]] = None) -> "Grid":
+    def square(
+        c: int = 1,
+        devices: Optional[Sequence[jax.Device]] = None,
+        layout: int = 0,
+        num_chunks: int = 0,
+    ) -> "Grid":
         """Build a d x d x c grid from all (or the given) devices.
 
-        Reference: topo::square ctor, topology.h:67-131.  The reference's
-        three rank->coordinate ``layout`` variants (incl. the 64-rank subcube
-        blocking, topology.h:104-123) are physical-placement tuning knobs; on
-        TPU the analogous knob is device order in the mesh, which XLA already
-        lays out for ICI locality, so layout is not exposed here.
+        Reference: topo::square ctor, topology.h:67-131.  ``layout`` is the
+        reference's rank->coordinate assignment knob (topology.h:77-123) —
+        on TPU it is the device-order-into-mesh permutation, the lever that
+        decides which mesh axes ride adjacent ICI links (see _order_devices).
         """
         devices = list(devices if devices is not None else jax.devices())
         d = _infer_square_face(len(devices), c)
-        dev = np.asarray(devices).reshape(d, d, c)
-        return Grid(mesh=Mesh(dev, AXES))
+        return Grid(
+            mesh=Mesh(_order_devices(devices, d, d, c, layout), AXES),
+            num_chunks=num_chunks,
+        )
 
     @staticmethod
     def rect(
@@ -100,6 +161,8 @@ class Grid:
         dy: int,
         c: int = 1,
         devices: Optional[Sequence[jax.Device]] = None,
+        layout: int = 0,
+        num_chunks: int = 0,
     ) -> "Grid":
         """Build a dx x dy x c grid (tunable shape, reference topo::rect).
 
@@ -111,8 +174,10 @@ class Grid:
         devices = list(devices if devices is not None else jax.devices())
         if dx * dy * c != len(devices):
             raise ValueError(f"{dx}*{dy}*{c} != {len(devices)} devices")
-        dev = np.asarray(devices).reshape(dx, dy, c)
-        return Grid(mesh=Mesh(dev, AXES))
+        return Grid(
+            mesh=Mesh(_order_devices(devices, dx, dy, c, layout), AXES),
+            num_chunks=num_chunks,
+        )
 
     @staticmethod
     def flat(devices: Optional[Sequence[jax.Device]] = None) -> "Grid":
@@ -192,7 +257,11 @@ class Grid:
         return pm, pn
 
     def __repr__(self) -> str:  # pragma: no cover
-        return f"Grid({self.dx}x{self.dy}x{self.c}, {self.mesh.devices.ravel()[0].platform})"
+        chunks = f", chunks={self.num_chunks}" if self.num_chunks > 1 else ""
+        return (
+            f"Grid({self.dx}x{self.dy}x{self.c}, "
+            f"{self.mesh.devices.ravel()[0].platform}{chunks})"
+        )
 
 
 def cpu_grid_square(c: int = 1, n: Optional[int] = None) -> Grid:

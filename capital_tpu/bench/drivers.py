@@ -115,12 +115,13 @@ def _grid(args) -> Grid:
 
 def cholinv(args) -> dict:
     grid = _grid(args)
+    mode = _resolve_mode(args.mode, grid)
     dtype = jnp.dtype(args.dtype)
     cfg = cholesky.CholinvConfig(
         complete_inv=not args.no_complete_inv,
         split=args.split,
         base_case_dim=args.bc,
-        mode=_resolve_mode(args.mode, grid),
+        mode=mode,
         precision=None if dtype.itemsize < 4 else "highest",
     )
     A = _spd(args.n, dtype)
@@ -133,7 +134,7 @@ def cholinv(args) -> dict:
     flops = 2.0 * args.n**3 / 3.0  # factor n³/3 + triangular inverse n³/3
     rec = harness.report(
         "cholinv_tflops", t, flops, dtype, n=args.n, grid=repr(grid), bc=args.bc,
-        **_knobs(args),
+        mode=mode, **_knobs(args),
     )
     if args.validate:
         R, Rinv = jax.jit(lambda a: cholesky.factor(grid, a, cfg))(A)
@@ -157,8 +158,10 @@ def cacqr(args) -> dict:
         dev = dev[: args.devices]
     if args.regime == "dist" or len(dev) == 1:
         grid = _grid(args)
+        applied_knobs = _knobs(args)
     else:
-        grid = Grid.flat(devices=dev)
+        grid = Grid.flat(devices=dev)  # natural order, unchunked
+        applied_knobs = dict(layout=0, chunks=0)
     dtype = jnp.dtype(args.dtype)
     cfg = qr.CacqrConfig(
         num_iter=args.variant,
@@ -187,7 +190,7 @@ def cacqr(args) -> dict:
     flops = 2.0 * args.m * args.n**2 * cfg.num_iter
     rec = harness.report(
         "cacqr_tflops", t, flops, dtype, m=args.m, n=args.n,
-        variant=args.variant, grid=repr(grid), **_knobs(args),
+        variant=args.variant, grid=repr(grid), **applied_knobs,
     )
     if args.validate:
         Q, R = jax.jit(lambda a: qr.factor(grid, a, cfg))(A)
@@ -227,10 +230,11 @@ def summa_gemm(args) -> dict:
 
 def rectri(args) -> dict:
     grid = _grid(args)
+    mode = _resolve_mode(args.mode, grid)
     dtype = jnp.dtype(args.dtype)
     A = _spd(args.n, jnp.float32)
     L = jnp.linalg.cholesky(A).astype(dtype)
-    cfg = inverse.RectriConfig(base_case_dim=args.bc)
+    cfg = inverse.RectriConfig(base_case_dim=args.bc, mode=mode)
 
     def step(a):
         return inverse.rectri(grid, a, "L", cfg)
@@ -238,7 +242,7 @@ def rectri(args) -> dict:
     t = harness.timed_loop(step, L, iters=args.iters)
     rec = harness.report(
         "rectri_tflops", t, args.n**3 / 3.0, dtype, n=args.n, grid=repr(grid),
-        **_knobs(args),
+        mode=mode, **_knobs(args),
     )
     if args.validate:
         Linv = jax.jit(lambda a: inverse.rectri(grid, a, "L", cfg))(L)
@@ -252,9 +256,12 @@ def rectri(args) -> dict:
 
 def newton(args) -> dict:
     grid = _grid(args)
+    # xla mode regardless of 'auto': Newton is two dense gemms per step,
+    # where the pallas path adds nothing (gemm falls through to xla anyway)
+    mode = args.mode if args.mode != "auto" else "xla"
     dtype = jnp.dtype(args.dtype)
     A = _spd(args.n, dtype)
-    cfg = inverse.NewtonConfig(max_iter=args.newton_iters)
+    cfg = inverse.NewtonConfig(max_iter=args.newton_iters, mode=mode)
 
     def step(a):
         X, _ = inverse.newton(grid, a, cfg)
@@ -266,7 +273,7 @@ def newton(args) -> dict:
     flops = 4.0 * args.n**3 * args.newton_iters
     rec = harness.report(
         "newton_tflops", t, flops, dtype, n=args.n, grid=repr(grid),
-        max_iters=args.newton_iters,
+        max_iters=args.newton_iters, mode=mode, **_knobs(args),
     )
     if args.validate:
         Ainv, _ = jax.jit(lambda a: inverse.newton(grid, a, cfg))(A)
@@ -280,9 +287,10 @@ def newton(args) -> dict:
 
 def spd_inverse(args) -> dict:
     grid = _grid(args)
+    mode = _resolve_mode(args.mode, grid)
     dtype = jnp.dtype(args.dtype)
     cfg = cholesky.CholinvConfig(
-        base_case_dim=args.bc, mode=_resolve_mode(args.mode, grid),
+        base_case_dim=args.bc, mode=mode,
         precision=None if dtype.itemsize < 4 else "highest",
     )
     A = _spd(args.n, dtype)
@@ -294,7 +302,7 @@ def spd_inverse(args) -> dict:
     flops = 2.0 * args.n**3 / 3.0 + args.n**3 / 3.0
     rec = harness.report(
         "spd_inverse_tflops", t, flops, dtype, n=args.n, grid=repr(grid),
-        **_knobs(args),
+        mode=mode, **_knobs(args),
     )
     if args.validate:
         Ainv = jax.jit(lambda a: cholesky.spd_inverse(grid, a, cfg))(A)

@@ -62,6 +62,13 @@ def _infer_square_face(num_devices: int, c: int) -> int:
     return d
 
 
+def layout2_eligible(dx: int, dy: int, c: int) -> bool:
+    """Whether the 2x2x2-subcube device ordering (layout=2) applies to this
+    grid shape — the single source of truth for the fallback condition in
+    _order_devices and for callers choosing a layout programmatically."""
+    return dx % 2 == 0 and dy % 2 == 0 and c % 2 == 0
+
+
 def _order_devices(
     devices: Sequence[jax.Device], dx: int, dy: int, c: int, layout: int
 ) -> np.ndarray:
@@ -89,7 +96,7 @@ def _order_devices(
     if layout == 1:
         return np.moveaxis(dev.reshape(c, dx, dy), 0, 2)
     if layout == 2:
-        if dx % 2 or dy % 2 or c % 2:
+        if not layout2_eligible(dx, dy, c):
             import warnings
 
             warnings.warn(

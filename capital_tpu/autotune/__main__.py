@@ -19,6 +19,11 @@ def main(argv=None) -> None:
         "--top-k", type=int, default=0,
         help="cholinv: measure only the native planner's top-k model candidates",
     )
+    p.add_argument(
+        "--resume", action="store_true",
+        help="checkpoint per-config results under --out and skip configs a "
+        "previous (preempted) sweep of the same problem already measured",
+    )
     p.add_argument("--devices", type=int, default=0)
     p.add_argument("--platform", default=None)
     p.add_argument("--host-devices", type=int, default=0)
@@ -50,12 +55,13 @@ def main(argv=None) -> None:
     if args.alg == "cholinv":
         grid = Grid.square(c=1, devices=dev)
         res = sweep.tune_cholinv(
-            grid, args.n, dtype, args.out, prefilter_top_k=args.top_k, **space
+            grid, args.n, dtype, args.out, prefilter_top_k=args.top_k,
+            checkpoint=args.resume, **space,
         )
     else:
         grid = Grid.flat(devices=dev)
         res = sweep.tune_cacqr(grid, args.m, args.n if args.n < args.m else 512,
-                               dtype, args.out, **space)
+                               dtype, args.out, checkpoint=args.resume, **space)
     best = res[0]
     print(f"best: {best.config_id}  {best.seconds * 1e3:.3f} ms  -> {args.out}/")
 

@@ -49,6 +49,75 @@ class SweepResult:
     recorder: tracing.Recorder
 
 
+# --------------------------------------------------------------------------
+# sweep checkpointing: hardware sweeps take tens of minutes per pass and the
+# environment can preempt them; a resumed sweep (CLI --resume) skips configs
+# already measured for the same (shape, dtype, device) problem.  The
+# reference has no such capability (its tune.cpp restarts from scratch).
+# --------------------------------------------------------------------------
+
+
+def _ckpt_key(name: str, operand, extra: dict | None = None) -> dict:
+    """Problem identity for resume: name, operand, device kind, and whatever
+    the caller adds (the grid topology — a 2x2x1 sweep's timings must never
+    be resumed into a 1-device sweep of the same matrix)."""
+    return {
+        "name": name,
+        "shape": list(operand.shape),
+        "dtype": str(operand.dtype),
+        "device": jax.devices()[0].device_kind,
+        **(extra or {}),
+    }
+
+
+def _ckpt_path(out_dir: str, name: str, key: dict) -> str:
+    """Checkpoint file keyed by the problem hash, so sweeps of different
+    problems sharing an out_dir cannot clobber each other's partial state."""
+    import hashlib
+
+    h = hashlib.sha256(json.dumps(key, sort_keys=True).encode()).hexdigest()[:10]
+    return os.path.join(out_dir, f"{name}_sweep_{h}.json")
+
+
+def _ckpt_load(path: str, key: dict) -> dict:
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {}
+    return data.get("done", {}) if data.get("key") == key else {}
+
+
+def _ckpt_save(path: str, key: dict, done: dict) -> None:
+    tmp = f"{path}.tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump({"key": key, "done": done}, f)
+    os.replace(tmp, path)  # atomic: a preemption mid-write tears nothing
+
+
+def _recorder_from(stats: dict) -> tracing.Recorder:
+    rec = tracing.Recorder()
+    for tag, s in stats.items():
+        ps = rec.stats[tag]
+        ps.calls = int(s["calls"])
+        ps.flops = float(s["flops"])
+        ps.comm_bytes = float(s["comm_bytes"])
+        ps.collectives = int(s["collectives"])
+    return rec
+
+
+def _recorder_dump(rec: tracing.Recorder) -> dict:
+    return {
+        tag: {
+            "calls": s.calls,
+            "flops": s.flops,
+            "comm_bytes": s.comm_bytes,
+            "collectives": s.collectives,
+        }
+        for tag, s in rec.stats.items()
+    }
+
+
 def _model_costs(step: Callable, operand) -> tracing.Recorder:
     """Capture the alpha-beta model decomposition for one config by tracing
     (no execution): phase emits fire at trace time."""
@@ -65,15 +134,40 @@ def run_sweep(
     out_dir: str = ".",
     iters: int = 2,
     dtype=None,
+    checkpoint: bool = False,
+    key_extra: dict | None = None,
 ) -> list[SweepResult]:
     """Measure + model every (config_id, config_dict, step_fn) and write the
-    cost tables.  Returns results sorted best-first by measured time."""
+    cost tables.  Returns results sorted best-first by measured time.
+
+    checkpoint=True persists per-config results to a problem-keyed
+    ``<out_dir>/<name>_sweep_<hash>.json`` after each measurement; a re-run
+    of the same problem (shape/dtype/device/topology) resumes, skipping
+    measured configs.  Unresolved (noise-floor) configs are NOT persisted —
+    the condition can be a transient drift window, so every resume retries
+    them."""
     dtype = dtype or operand.dtype
     configs = list(configs)
     if not configs:
         raise ValueError(f"autotune sweep {name!r}: no configs to sweep")
+    key = _ckpt_key(name, operand, key_extra)
+    ckpt_path = _ckpt_path(out_dir, name, key)
+    done: dict = {}
+    if checkpoint:
+        os.makedirs(out_dir, exist_ok=True)
+        done = _ckpt_load(ckpt_path, key)
     results: list[SweepResult] = []
     for cid, cdict, step in configs:
+        if cid in done:
+            entry = done[cid]
+            results.append(
+                SweepResult(
+                    cid, entry["config"], entry["seconds"],
+                    _recorder_from(entry["stats"]),
+                )
+            )
+            print(f"# autotune {name}: {cid}  {entry['seconds'] * 1e3:.3f} ms (resumed)")
+            continue
         rec = _model_costs(step, operand)
         try:
             secs = harness.timed_loop(step, operand, iters=iters)
@@ -81,9 +175,14 @@ def run_sweep(
             # below the measurement noise floor: record nothing for this
             # config rather than aborting the sweep and losing the rest
             print(f"# autotune {name}: {cid}  UNRESOLVED ({e})")
-            continue
+            continue  # deliberately not checkpointed: retried on resume
         results.append(SweepResult(cid, cdict, secs, rec))
         print(f"# autotune {name}: {cid}  {secs * 1e3:.3f} ms")
+        if checkpoint:
+            done[cid] = {
+                "config": cdict, "seconds": secs, "stats": _recorder_dump(rec),
+            }
+            _ckpt_save(ckpt_path, key, done)
 
     os.makedirs(out_dir, exist_ok=True)
     spec = tracing.device_spec()
@@ -192,6 +291,7 @@ def tune_cholinv(
     dtype=jnp.bfloat16,
     out_dir: str = "autotune_out",
     prefilter_top_k: int = 0,
+    checkpoint: bool = False,
     **space,
 ) -> list[SweepResult]:
     """Sweep cholinv configs.  With prefilter_top_k > 0, the native
@@ -222,15 +322,25 @@ def tune_cholinv(
             f"# autotune cholinv: planner kept {len(kept)}/{len(configs)} configs"
         )
         configs = kept
-    return run_sweep("cholinv", configs, A, out_dir, dtype=dtype)
+    return run_sweep(
+        "cholinv", configs, A, out_dir, dtype=dtype, checkpoint=checkpoint,
+        key_extra={"grid": repr(grid)},
+    )
 
 
 def tune_cacqr(
-    grid: Grid, m: int, n: int, dtype=jnp.bfloat16, out_dir: str = "autotune_out", **space
+    grid: Grid,
+    m: int,
+    n: int,
+    dtype=jnp.bfloat16,
+    out_dir: str = "autotune_out",
+    checkpoint: bool = False,
+    **space,
 ) -> list[SweepResult]:
     A = jax.block_until_ready(
         jax.random.normal(jax.random.key(0), (m, n), dtype=dtype)
     )
     return run_sweep(
-        "cacqr", cacqr_space(grid, dtype, **space), A, out_dir, dtype=dtype
+        "cacqr", cacqr_space(grid, dtype, **space), A, out_dir, dtype=dtype,
+        checkpoint=checkpoint, key_extra={"grid": repr(grid)},
     )

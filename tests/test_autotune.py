@@ -50,3 +50,55 @@ def test_cacqr_sweep(tmp_path):
     assert len(res) == 2
     assert {"CQR::gram", "CQR::chol", "CQR::formR"} <= set(res[0].recorder.stats)
     assert os.path.exists(tmp_path / "cacqr_best.json")
+
+
+def test_sweep_resume_skips_measured_configs(tmp_path, monkeypatch):
+    """A preempted sweep re-run with checkpoint=True resumes: configs in the
+    per-config checkpoint are not re-measured, results/tables are identical,
+    and a different problem key ignores the stale checkpoint."""
+    from capital_tpu.bench import harness
+
+    grid = Grid.square(c=1, devices=jax.devices("cpu")[:1])
+    res1 = sweep.tune_cholinv(
+        grid, 128, jnp.float32, str(tmp_path),
+        bc_dims=(32, 64), splits=(1,), checkpoint=True,
+    )
+    import glob as _glob
+
+    ckpts = _glob.glob(str(tmp_path / "cholinv_sweep_*.json"))
+    assert len(ckpts) == 1
+
+    calls = []
+    real = harness.timed_loop
+
+    def counting(*a, **k):
+        calls.append(1)
+        return real(*a, **k)
+
+    monkeypatch.setattr(harness, "timed_loop", counting)
+    res2 = sweep.tune_cholinv(
+        grid, 128, jnp.float32, str(tmp_path),
+        bc_dims=(32, 64), splits=(1,), checkpoint=True,
+    )
+    assert not calls  # everything resumed, nothing re-measured
+    assert [r.config_id for r in res2] == [r.config_id for r in res1]
+    assert [r.seconds for r in res2] == [r.seconds for r in res1]
+    # recorder stats survive the JSON round trip
+    assert res2[0].recorder.total().flops == res1[0].recorder.total().flops
+
+    # a different problem size must NOT resume from this checkpoint
+    res3 = sweep.tune_cholinv(
+        grid, 192, jnp.float32, str(tmp_path),
+        bc_dims=(32,), splits=(1,), checkpoint=True,
+    )
+    assert calls  # measured fresh
+    assert len(res3) == 2
+    # the two problems keep separate checkpoint files (no clobbering): the
+    # original can still resume after the second sweep ran in the same dir
+    assert len(_glob.glob(str(tmp_path / "cholinv_sweep_*.json"))) == 2
+    calls.clear()
+    sweep.tune_cholinv(
+        grid, 128, jnp.float32, str(tmp_path),
+        bc_dims=(32, 64), splits=(1,), checkpoint=True,
+    )
+    assert not calls  # n=128 sweep still fully resumable

@@ -81,7 +81,7 @@ def main() -> None:
     _enable_compile_cache()
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 32768
     dtype = jnp.dtype(sys.argv[2]) if len(sys.argv) > 2 else jnp.bfloat16
-    iters = int(sys.argv[3]) if len(sys.argv) > 3 else 3
+    iters = int(sys.argv[3]) if len(sys.argv) > 3 else 5
 
     from capital_tpu.models import cholesky
     from capital_tpu.parallel.topology import Grid
@@ -136,14 +136,16 @@ def main() -> None:
         return time.perf_counter() - t0
 
     timed(1)  # warmup: compile (dynamic trip count -> one executable)
+    timed(1)  # second warmup: let clocks/tunnel state settle post-compile
     # Noise discipline: host-side walls through the tunnel carry multi-ms
-    # jitter and the machine's throughput drifts run to run, so a single
-    # (iters+1)-minus-1 delta can be off by 2x in either direction.  Take
-    # the min over repeats of each endpoint (min discards contention
-    # spikes; the lower bound is the hardware's actual speed) and difference
-    # the mins.
-    base = min(timed(1) for _ in range(5))
-    full = min(timed(iters + 1) for _ in range(5))
+    # jitter and the machine's throughput drifts 2-3x on a minutes timescale,
+    # so a single (iters+1)-minus-1 delta can be off by 2x in either
+    # direction.  Take the min over repeats of each endpoint (min discards
+    # contention spikes and slow-drift windows; the lower bound is the
+    # hardware's actual speed) and difference the mins — 8 repeats spans
+    # enough wall time to usually catch a clean window of each.
+    base = min(timed(1) for _ in range(8))
+    full = min(timed(iters + 1) for _ in range(8))
     t = (full - base) / iters
 
     flops = 2.0 * n**3 / 3.0  # factor (n^3/3) + full triangular inverse (n^3/3)

@@ -57,6 +57,17 @@ class SweepResult:
 # --------------------------------------------------------------------------
 
 
+def _grid_key(grid: Grid) -> dict:
+    """Topology identity for the resume key: shape AND the concrete device
+    ordering (device ids in mesh order), which captures the layout knob —
+    two grids differing only in layout place devices differently, time
+    collectives differently, and must not share resumed timings."""
+    return {
+        "grid": repr(grid),
+        "devices": [int(d.id) for d in grid.mesh.devices.ravel()],
+    }
+
+
 def _ckpt_key(name: str, operand, extra: dict | None = None) -> dict:
     """Problem identity for resume: name, operand, device kind, and whatever
     the caller adds (the grid topology — a 2x2x1 sweep's timings must never
@@ -89,33 +100,29 @@ def _ckpt_load(path: str, key: dict) -> dict:
 
 
 def _ckpt_save(path: str, key: dict, done: dict) -> None:
+    # same atomic-rename discipline as utils/checkpoint.save; kept separate
+    # because sweep state is pure JSON (no arrays — npz would bury the
+    # human-inspectable per-config record the sweep wants to expose)
     tmp = f"{path}.tmp{os.getpid()}"
-    with open(tmp, "w") as f:
-        json.dump({"key": key, "done": done}, f)
-    os.replace(tmp, path)  # atomic: a preemption mid-write tears nothing
+    try:
+        with open(tmp, "w") as f:
+            json.dump({"key": key, "done": done}, f)
+        os.replace(tmp, path)  # atomic: a preemption mid-write tears nothing
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
 
 
 def _recorder_from(stats: dict) -> tracing.Recorder:
     rec = tracing.Recorder()
     for tag, s in stats.items():
-        ps = rec.stats[tag]
-        ps.calls = int(s["calls"])
-        ps.flops = float(s["flops"])
-        ps.comm_bytes = float(s["comm_bytes"])
-        ps.collectives = int(s["collectives"])
+        # dataclass round trip: a future PhaseStats field restores too
+        rec.stats[tag].merge(tracing.PhaseStats(**s))
     return rec
 
 
 def _recorder_dump(rec: tracing.Recorder) -> dict:
-    return {
-        tag: {
-            "calls": s.calls,
-            "flops": s.flops,
-            "comm_bytes": s.comm_bytes,
-            "collectives": s.collectives,
-        }
-        for tag, s in rec.stats.items()
-    }
+    return {tag: dataclasses.asdict(s) for tag, s in rec.stats.items()}
 
 
 def _model_costs(step: Callable, operand) -> tracing.Recorder:
@@ -324,7 +331,7 @@ def tune_cholinv(
         configs = kept
     return run_sweep(
         "cholinv", configs, A, out_dir, dtype=dtype, checkpoint=checkpoint,
-        key_extra={"grid": repr(grid)},
+        key_extra=_grid_key(grid),
     )
 
 
@@ -342,5 +349,5 @@ def tune_cacqr(
     )
     return run_sweep(
         "cacqr", cacqr_space(grid, dtype, **space), A, out_dir, dtype=dtype,
-        checkpoint=checkpoint, key_extra={"grid": repr(grid)},
+        checkpoint=checkpoint, key_extra=_grid_key(grid),
     )

@@ -135,18 +135,27 @@ def main() -> None:
         float(loop(A, eps, k))  # host transfer = real sync
         return time.perf_counter() - t0
 
+    from capital_tpu.bench import harness
+
     timed(1)  # warmup: compile (dynamic trip count -> one executable)
     timed(1)  # second warmup: let clocks/tunnel state settle post-compile
-    # Noise discipline: host-side walls through the tunnel carry multi-ms
-    # jitter and the machine's throughput drifts 2-3x on a minutes timescale,
-    # so a single (iters+1)-minus-1 delta can be off by 2x in either
-    # direction.  Take the min over repeats of each endpoint (min discards
-    # contention spikes and slow-drift windows; the lower bound is the
-    # hardware's actual speed) and difference the mins — 8 repeats spans
-    # enough wall time to usually catch a clean window of each.
-    base = min(timed(1) for _ in range(8))
-    full = min(timed(iters + 1) for _ in range(8))
-    t = (full - base) / iters
+    # Interleaved (base, full) pairs + median — the one protocol shared with
+    # harness.timed_loop; see paired_median_delta for the drift-bias story.
+    def run(k: int) -> float:
+        return timed(k)
+
+    t, delta = harness.paired_median_delta(run, iters, 8)
+    noise = harness.noise_band_seconds()
+    while iters < 512 and delta < noise:
+        # small-n runs: grow the in-jit loop until the delta clears the band
+        grow = int(3.0 * noise / t) if t > 0.0 else iters * 8
+        iters = min(512, max(iters * 2, grow))
+        t, delta = harness.paired_median_delta(run, iters, 5)
+    if t <= 0.0 or delta < noise:
+        raise SystemExit(
+            f"measurement unresolved: delta {delta:.3e}s at {iters} "
+            "iterations is inside the dispatch-noise band"
+        )
 
     flops = 2.0 * n**3 / 3.0  # factor (n^3/3) + full triangular inverse (n^3/3)
     tflops = flops / t / 1e12

@@ -46,6 +46,38 @@ class MeasurementUnresolved(RuntimeError):
     compile errors — subclasses RuntimeError)."""
 
 
+def noise_band_seconds() -> float:
+    """The dispatch-noise band a measured delta must clear to be trusted:
+    ~50ms on the TPU tunnel (~70ms fixed dispatch + multi-ms jitter)."""
+    import jax as _jax
+
+    return 0.05 if _jax.default_backend() == "tpu" else 0.002
+
+
+def paired_median_delta(run, k: int, nrep: int) -> tuple[float, float]:
+    """(per-iteration seconds, raw delta): median over INTERLEAVED
+    (base, full) wall pairs of `run(1)` vs `run(k+1)`.
+
+    The one measurement protocol shared by the flagship bench.py and
+    timed_loop.  Adjacent pairs share a drift window, so the delta isolates
+    the in-jit iterations; sampling all bases then all fulls lets monotone
+    drift between the blocks bias the result (observed: 16.8 ms/iter
+    reported for a step whose device-counter op time is 26.6 ms and whose
+    200-iteration sustained marginal is 24.9 ms).  The median rejects
+    jitter outliers — a single paired delta can even go negative for sub-ms
+    steps, which once let an autotune sweep crown a config with a negative
+    "time"."""
+    import statistics
+
+    deltas = []
+    for _ in range(nrep):
+        b = run(1)
+        f = run(k + 1)
+        deltas.append(f - b)
+    d = statistics.median(deltas)
+    return d / k, d
+
+
 def timed_loop(
     step: Callable[[jnp.ndarray], jnp.ndarray],
     operand: jnp.ndarray,
@@ -53,9 +85,9 @@ def timed_loop(
     repeats: int = 3,
 ) -> float:
     """Per-iteration seconds of `step`, run `iters` times inside jit —
-    the min-over-repeats of each endpoint (1 and iters+1 trips),
-    differenced; escalates the trip count when the delta is below the
-    tunnel noise floor.  Raises if it never resolves.
+    the median over interleaved (1-trip, iters+1-trip) wall pairs
+    (paired_median_delta); escalates the trip count when the delta is below
+    the tunnel noise band.  Raises if it never resolves.
 
     `step(operand) -> array of operand's shape/dtype` must consume all the
     outputs it wants timed (see module docstring on DCE).  The perturbation
@@ -90,33 +122,25 @@ def timed_loop(
         return time.perf_counter() - t0
 
     run(1)  # compile (dynamic trip count -> one executable reused for both k)
-    # Noise discipline (same as bench.py): host walls through the TPU tunnel
-    # carry multi-ms jitter, so difference the MIN of each endpoint — a
-    # single paired delta can even go negative for sub-ms steps, which once
-    # let an autotune sweep crown a config with a negative "time".
-    base = min(run(1) for _ in range(repeats + 2))
-    full = min(run(iters + 1) for _ in range(repeats + 2))
-    t = (full - base) / iters
-    # Escalate the trip count until the DELTA clears the noise band — on the
-    # TPU tunnel that band is ~50ms (~70ms fixed dispatch + multi-ms
-    # jitter): a positive but small delta is still mostly noise (a ~2ms step
-    # was observed reporting 13ms when the total delta sat at ~40ms).  Aim
-    # the loop at a >=3x-band delta.
-    noise = 0.05 if jax.default_backend() == "tpu" else 0.002
+    t, delta = paired_median_delta(run, iters, repeats + 2)
+    # Escalate the trip count until the DELTA clears the noise band: a
+    # positive but small delta is still mostly noise (a ~2ms step was
+    # observed reporting 13ms when the total delta sat at ~40ms).  Aim the
+    # loop at a >=3x-band delta.
+    noise = noise_band_seconds()
     k = iters
-    while k < 4096 and (full - base) < noise:
+    while k < 4096 and delta < noise:
         grow = int(3.0 * noise / t) if t > 0.0 else k * 8
         k = min(4096, max(k * 2, grow))
-        full = min(run(k + 1) for _ in range(repeats))
-        t = (full - base) / k
-    if t <= 0.0 or (full - base) < noise:
+        t, delta = paired_median_delta(run, k, repeats)
+    if t <= 0.0 or delta < noise:
         # never resolved: refuse to return a fake number (a silent floor
         # once let a noise artifact win an autotune sweep; a positive delta
         # still inside the noise band at the trip-count cap is the same
         # artifact with extra steps)
         raise MeasurementUnresolved(
             f"timed_loop could not resolve a per-iteration time (delta "
-            f"{full - base:.3e}s after {k} iterations is inside the "
+            f"{delta:.3e}s after {k} iterations is inside the "
             f"{noise:.0e}s dispatch-noise band)"
         )
     return t

@@ -81,7 +81,7 @@ def _resolve_mode(mode: str, grid: Grid) -> str:
     rig stays on xla."""
     if mode != "auto":
         return mode
-    one_tpu = grid.num_devices == 1 and jax.default_backend() == "tpu"
+    one_tpu = grid.num_devices == 1 and grid.platform == "tpu"
     return "pallas" if one_tpu else "xla"
 
 
@@ -163,10 +163,16 @@ def cacqr(args) -> dict:
         grid = Grid.flat(devices=dev)  # natural order, unchunked
         applied_knobs = dict(layout=0, chunks=0)
     dtype = jnp.dtype(args.dtype)
+    mode = _resolve_mode(args.mode, grid)
+    precision = None if dtype.itemsize < 4 else "highest"
     cfg = qr.CacqrConfig(
         num_iter=args.variant,
         regime=args.regime,
-        precision=None if dtype.itemsize < 4 else "highest",
+        mode=mode,
+        cholinv=cholesky.CholinvConfig(
+            base_case_dim=args.bc, mode=mode, precision=precision
+        ),
+        precision=precision,
     )
     # generate on device directly at the target dtype (an f32 staging
     # buffer alone is 8GB at the 2M x 1024 BASELINE shape)
@@ -190,7 +196,7 @@ def cacqr(args) -> dict:
     flops = 2.0 * args.m * args.n**2 * cfg.num_iter
     rec = harness.report(
         "cacqr_tflops", t, flops, dtype, m=args.m, n=args.n,
-        variant=args.variant, grid=repr(grid), **applied_knobs,
+        variant=args.variant, grid=repr(grid), mode=mode, **applied_knobs,
     )
     if args.validate:
         Q, R = jax.jit(lambda a: qr.factor(grid, a, cfg))(A)

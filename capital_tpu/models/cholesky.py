@@ -291,6 +291,7 @@ def _recurse(
     return Rp, RIp
 
 
+@pallas_tpu.scoped_by_grid
 def factor(
     grid: Grid, A: jnp.ndarray, cfg: CholinvConfig = CholinvConfig()
 ) -> tuple[jnp.ndarray, jnp.ndarray]:

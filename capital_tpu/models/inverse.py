@@ -33,7 +33,7 @@ import dataclasses
 import jax.numpy as jnp
 from jax import lax
 
-from capital_tpu.ops import lapack
+from capital_tpu.ops import lapack, pallas_tpu
 from capital_tpu.parallel import summa
 from capital_tpu.parallel.summa import GemmArgs
 from capital_tpu.parallel.topology import Grid
@@ -49,6 +49,7 @@ class RectriConfig:
     precision: str | None = "highest"
 
 
+@pallas_tpu.scoped_by_grid
 def rectri(
     grid: Grid,
     T: jnp.ndarray,
@@ -106,6 +107,7 @@ class NewtonConfig:
     precision: str | None = "highest"
 
 
+@pallas_tpu.scoped_by_grid
 def newton(
     grid: Grid, A: jnp.ndarray, cfg: NewtonConfig = NewtonConfig()
 ) -> tuple[jnp.ndarray, jnp.ndarray]:

@@ -49,7 +49,7 @@ from jax import lax
 
 from capital_tpu.models import cholesky
 from capital_tpu.models.cholesky import CholinvConfig
-from capital_tpu.ops import lapack
+from capital_tpu.ops import lapack, pallas_tpu
 from capital_tpu.parallel import summa
 from capital_tpu.parallel.summa import GemmArgs, SyrkArgs, TrmmArgs
 from capital_tpu.parallel.topology import Grid
@@ -87,7 +87,7 @@ class CacqrConfig:
 
 
 def _sweep_1d(
-    grid: Grid, A: jnp.ndarray, precision: str | None
+    grid: Grid, A: jnp.ndarray, cfg: CacqrConfig
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """One CQR sweep, 1D regime (reference sweep_1d, cacqr.hpp:7-29).
 
@@ -95,27 +95,56 @@ def _sweep_1d(
     AᵀA is written globally and pinned replicated — XLA emits the local
     partial product and the all-axis psum, the exact analog of the
     reference's local syrk + MPI_Allreduce over world (cacqr.hpp:14-25).
+
+    On a single device with cfg.mode='pallas' both big contractions route
+    through the live-tile kernels — the reference's local cblas_dsyrk /
+    cblas_dtrmm flop savings (cacqr.hpp:14,25): the gram computes only the
+    upper triangle of AᵀA (~half the mn² flops) and Q = A·R⁻¹ skips R⁻¹'s
+    dead lower blocks; the Cholesky pair then reads only the gram's valid
+    upper triangle (potrf_trtri_upper).
     """
     m, n = A.shape
+    precision = cfg.precision
+    use_pallas = cfg.mode == "pallas" and grid.num_devices == 1
     A = lax.with_sharding_constraint(A, grid.rows_sharding())
     # phase tags follow the reference symbols CQR::gram / CQR::formR
     # (cacqr.hpp:82-116)
     with tracing.scope("CQR::gram"):
-        comm, ncoll = tracing.allreduce_cost(grid, n, n, A.dtype, axes="all")
-        tracing.emit(
-            flops=2.0 * m * n * n / grid.num_devices, comm_bytes=comm, collectives=ncoll
-        )
-        G = lax.with_sharding_constraint(
-            jnp.matmul(A.T, A, precision=precision), grid.replicated_sharding()
-        )
+        if use_pallas:
+            # summa.syrk emits its own (halved) cost attribution
+            G = summa.syrk(
+                grid, A,
+                args=SyrkArgs(trans=True, precision=precision), mode="pallas",
+            )
+        else:
+            comm, ncoll = tracing.allreduce_cost(grid, n, n, A.dtype, axes="all")
+            tracing.emit(
+                flops=2.0 * m * n * n / grid.num_devices,
+                comm_bytes=comm, collectives=ncoll,
+            )
+            G = lax.with_sharding_constraint(
+                jnp.matmul(A.T, A, precision=precision),
+                grid.replicated_sharding(),
+            )
     with tracing.scope("CQR::chol"):
         tracing.emit(flops=tracing.potrf_trtri_flops(n))
-        R, Rinv = lapack.potrf_trtri(G, uplo="U")
+        if use_pallas:
+            # the pallas syrk left the gram's lower half dead/undefined
+            R, Rinv = lapack.potrf_trtri_upper(G)
+        else:
+            R, Rinv = lapack.potrf_trtri(G, uplo="U")
     with tracing.scope("CQR::formR"):
-        tracing.emit(flops=2.0 * m * n * n / grid.num_devices)
-        Q = lax.with_sharding_constraint(
-            jnp.matmul(A, Rinv, precision=precision), grid.rows_sharding()
-        )
+        if use_pallas:
+            Q = summa.trmm(
+                grid, Rinv, A,
+                TrmmArgs(side="R", uplo="U", precision=precision),
+                mode="pallas",
+            )
+        else:
+            tracing.emit(flops=2.0 * m * n * n / grid.num_devices)
+            Q = lax.with_sharding_constraint(
+                jnp.matmul(A, Rinv, precision=precision), grid.rows_sharding()
+            )
     return Q, R
 
 
@@ -201,6 +230,7 @@ def _pick_regime(grid: Grid, n: int, cfg: CacqrConfig) -> str:
     return "1d" if n <= cfg.dist_threshold else "dist"
 
 
+@pallas_tpu.scoped_by_grid
 def factor(
     grid: Grid, A: jnp.ndarray, cfg: CacqrConfig = CacqrConfig()
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
@@ -217,7 +247,7 @@ def factor(
         raise ValueError(f"num_iter must be 1 (CQR) or 2 (CQR2), got {cfg.num_iter}")
     regime = _pick_regime(grid, n, cfg)
     sweep = (
-        (lambda a: _sweep_1d(grid, a, cfg.precision))
+        (lambda a: _sweep_1d(grid, a, cfg))
         if regime == "1d"
         else (lambda a: _sweep_dist(grid, a, cfg))
     )

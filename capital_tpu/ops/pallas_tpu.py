@@ -60,6 +60,9 @@ the tiles the algorithm will never write.
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
+import functools
 import math
 
 import jax
@@ -69,9 +72,63 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# Platform resolution for interpret/tile decisions.  The process default
+# backend is the wrong thing to key off in a mixed environment: a CPU mesh in
+# a TPU-backed process (the driver's dryrun_multichip with
+# --xla_force_host_platform_device_count) would pick the Mosaic lowering and
+# die with "Only interpret mode is supported on CPU backend".  Kernels must
+# follow the platform of the devices that will run them — threaded from the
+# Grid via `platform_scope` (every grid-taking entry point is wrapped with
+# `scoped_by_grid`); direct kernel calls without a scope fall back to the
+# process default.
+# A ContextVar, not a module list: JAX permits tracing from multiple
+# threads, and a shared stack would leak one thread's platform into
+# another's kernels.
+_PLATFORM_SCOPE: contextvars.ContextVar[tuple[str, ...]] = contextvars.ContextVar(
+    "capital_tpu_platform_scope", default=()
+)
+
+
+def _default_backend() -> str:
+    # separate symbol so tests can simulate a TPU-default process on a
+    # CPU-only box by monkeypatching this, without touching jax internals
+    return jax.default_backend()
+
+
+@contextlib.contextmanager
+def platform_scope(platform: str | None):
+    """Resolve interpret-mode and tile-budget decisions against `platform`
+    (e.g. the mesh devices' platform) instead of jax.default_backend()."""
+    if platform is None:
+        yield
+        return
+    token = _PLATFORM_SCOPE.set(_PLATFORM_SCOPE.get() + (platform,))
+    try:
+        yield
+    finally:
+        _PLATFORM_SCOPE.reset(token)
+
+
+def scoped_by_grid(fn):
+    """Decorator for `fn(grid, ...)` entry points: every Pallas call traced
+    inside runs under the grid's platform scope, so a CPU mesh gets the
+    interpreter even when the process default backend is a TPU."""
+
+    @functools.wraps(fn)
+    def wrapper(grid, *args, **kwargs):
+        with platform_scope(grid.platform):
+            return fn(grid, *args, **kwargs)
+
+    return wrapper
+
+
+def _platform() -> str:
+    stack = _PLATFORM_SCOPE.get()
+    return stack[-1] if stack else _default_backend()
+
 
 def _interpret_default() -> bool:
-    return jax.default_backend() != "tpu"
+    return _platform() != "tpu"
 
 
 def _round_up(x: int, m: int) -> int:
@@ -88,9 +145,9 @@ def _device_budget() -> tuple[int, int | None]:
     (512,512,2048) @ default (XLA's own gemm: 167), trmm 140 / syrk 142 TF/s
     useful vs 124/132.  Other/unknown chips keep the conservative 512 tiles
     and Mosaic's own limit, which fit everywhere."""
-    if jax.default_backend() != "tpu":
+    if _platform() != "tpu":
         return 512, None
-    kind = jax.devices()[0].device_kind.lower()
+    kind = jax.devices("tpu")[0].device_kind.lower()
     if any(t in kind for t in ("v5 lite", "v5e", "v5p", "v6")):
         return 1024, 100 * 2**20
     return 512, None
@@ -164,7 +221,8 @@ def _b_live(j: int, k: int, bn: int, bk: int, uplo: str, trans: bool) -> bool:
 
 
 def _make_accumulate(
-    *, a_uplo, a_trans, b_uplo, b_trans, bm, bn, bk, acc_dtype, precision
+    *, a_uplo, a_trans, b_uplo, b_trans, bm, bn, bk, acc_dtype, precision,
+    operand_dtypes=(),
 ):
     """The shared inner body: mask diagonal-straddling tiles against global
     indices, contract on the MXU, accumulate into VMEM scratch."""
@@ -174,6 +232,15 @@ def _make_accumulate(
     # get full passes instead of NotImplementedError at lowering time
     if precision == "high":
         precision = "highest"
+    # sub-f32 operands are single-pass exact into the f32 accumulator —
+    # 'highest' adds nothing, and Mosaic rejects fp32 contract precision on
+    # bf16 inputs outright ("Bad lhs type"), so drop the request
+    if (
+        precision is not None
+        and operand_dtypes
+        and all(jnp.dtype(d).itemsize < 4 for d in operand_dtypes)
+    ):
+        precision = None
 
     def accumulate(a_ref, b_ref, acc_ref, i, j, k):
         a = a_ref[:]
@@ -538,12 +605,13 @@ def tri_matmul(
     else:
         out_dtype = jnp.result_type(A, B)
     acc_dtype = jnp.promote_types(jnp.result_type(A, B), jnp.float32)
-    if jnp.dtype(acc_dtype).itemsize > 4 and jax.default_backend() == "tpu":
+    if jnp.dtype(acc_dtype).itemsize > 4 and _platform() == "tpu":
         acc_dtype = jnp.float32
 
     accumulate = _make_accumulate(
         a_uplo=a_uplo, a_trans=a_trans, b_uplo=b_uplo, b_trans=b_trans,
         bm=bm, bn=bn, bk=bk, acc_dtype=acc_dtype, precision=precision,
+        operand_dtypes=(A.dtype, B.dtype),
     )
     a_shape = (bk, bm) if a_trans else (bm, bk)
     b_shape = (bn, bk) if b_trans else (bk, bn)

@@ -192,6 +192,7 @@ def _matmul(
     raise ValueError(f"unknown summa mode {mode!r}")
 
 
+@pallas_tpu.scoped_by_grid
 def gemm(
     grid: Grid,
     A: jnp.ndarray,
@@ -219,6 +220,7 @@ def _take_view(X, view):
     return pallas_tpu._window(X, view)
 
 
+@pallas_tpu.scoped_by_grid
 def trmm(
     grid: Grid,
     A: jnp.ndarray,
@@ -285,6 +287,7 @@ def trmm(
     return grid.pin(res)
 
 
+@pallas_tpu.scoped_by_grid
 def syrk(
     grid: Grid,
     A: jnp.ndarray,

@@ -220,6 +220,13 @@ class Grid:
     def is_square(self) -> bool:
         return self.dx == self.dy
 
+    @property
+    def platform(self) -> str:
+        """Platform of the mesh's devices ('tpu'/'cpu'/...).  Kernel dispatch
+        must key off this, never jax.default_backend(): a CPU mesh can live in
+        a TPU-backed process (the driver's multichip dryrun)."""
+        return self.mesh.devices.ravel()[0].platform
+
     # ---- sharding helpers --------------------------------------------------
 
     def face_sharding(self) -> NamedSharding:

@@ -68,15 +68,24 @@ def _grid_key(grid: Grid) -> dict:
     }
 
 
+# Bump on any kernel or measurement-protocol change that invalidates stored
+# timings (e.g. the paired-median drift protocol, tri-operand bk halving):
+# resumed sweeps must not mix pre-change checkpointed numbers with fresh ones
+# and crown a stale config.
+MEASUREMENT_PROTOCOL_VERSION = 2
+
+
 def _ckpt_key(name: str, operand, extra: dict | None = None) -> dict:
-    """Problem identity for resume: name, operand, device kind, and whatever
-    the caller adds (the grid topology — a 2x2x1 sweep's timings must never
-    be resumed into a 1-device sweep of the same matrix)."""
+    """Problem identity for resume: name, operand, device kind, protocol
+    version, and whatever the caller adds (the grid topology — a 2x2x1
+    sweep's timings must never be resumed into a 1-device sweep of the same
+    matrix)."""
     return {
         "name": name,
         "shape": list(operand.shape),
         "dtype": str(operand.dtype),
         "device": jax.devices()[0].device_kind,
+        "protocol": MEASUREMENT_PROTOCOL_VERSION,
         **(extra or {}),
     }
 

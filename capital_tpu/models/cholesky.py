@@ -114,6 +114,19 @@ def padded_dim(n: int, base_case_dim: int) -> int:
     return p
 
 
+def pad_embed_identity(X: jnp.ndarray, n: int, p: int) -> jnp.ndarray:
+    """Embed the n x n matrix X in diag(X, I) of size p — the structure-safe
+    pad (reference pads to a power of two, util.hpp:249-264): SPD stays SPD
+    and factors to diag(R, I); triangular stays triangular and inverts to
+    diag(X⁻¹, I).  Shared by cholinv and rectri so padding policy cannot
+    drift between them."""
+    if p == n:
+        return X
+    Xp = jnp.pad(X, ((0, p - n), (0, p - n)))
+    ii = jnp.arange(p)
+    return Xp + jnp.diag((ii >= n).astype(X.dtype))
+
+
 def top_split(n: int, cfg: CholinvConfig) -> int:
     """Column index where factor()'s top-level recursion splits the (cropped)
     n x n output — i.e. the boundary of the zeroed off-diagonal block of Rinv
@@ -306,15 +319,8 @@ def factor(
     if A.shape[0] != A.shape[1]:
         raise ValueError(f"cholinv needs a square matrix, got {A.shape}")
     p = padded_dim(n, cfg.base_case_dim)
-    if p != n:
-        # SPD-safe pad: diag(A, I) factors to diag(R, I) without cross-talk.
-        pad = ((0, p - n), (0, p - n))
-        Ap = jnp.pad(A, pad)
-        ii = jnp.arange(p)
-        Ap = Ap + jnp.diag((ii >= n).astype(A.dtype))
-    else:
-        Ap = A
-    Ap = grid.pin(Ap)
+    # SPD-safe pad: diag(A, I) factors to diag(R, I) without cross-talk.
+    Ap = grid.pin(pad_embed_identity(A, n, p))
     node = plan(p, cfg)
 
     def _leaves_aligned(nd: PlanNode, tile: int) -> bool:

@@ -35,7 +35,7 @@ from jax import lax
 
 from capital_tpu.ops import lapack, pallas_tpu
 from capital_tpu.parallel import summa
-from capital_tpu.parallel.summa import GemmArgs
+from capital_tpu.parallel.summa import GemmArgs, TrmmArgs
 from capital_tpu.parallel.topology import Grid
 
 
@@ -49,6 +49,54 @@ class RectriConfig:
     precision: str | None = "highest"
 
 
+def _rectri_into(
+    grid: Grid,
+    Tp: jnp.ndarray,
+    out: jnp.ndarray,
+    off: int,
+    size: int,
+    cfg: RectriConfig,
+) -> jnp.ndarray:
+    """Invert the lower-triangular window (off, off, size, size) of Tp into
+    the same window of the flat buffer `out` (consumed; in-place on the
+    pallas path)."""
+    if size <= cfg.base_case_dim:
+        window = lax.slice(Tp, (off, off), (off + size, off + size))
+        if grid.num_devices > 1:
+            window = lax.with_sharding_constraint(
+                window, grid.replicated_sharding()
+            )
+        inv = lapack.trtri(window, uplo="L")
+        return grid.pin(
+            lax.dynamic_update_slice(out, inv.astype(out.dtype), (off, off))
+        )
+
+    n1 = size // 2
+    n2 = size - n1
+    out = _rectri_into(grid, Tp, out, off, n1, cfg)
+    out = _rectri_into(grid, Tp, out, off + n1, n2, cfg)
+    # B21 = −L22⁻¹ · L21 · L11⁻¹ (the TODO sketch at rectri.hpp:70-99),
+    # as two triangular products read/written through views of the flat
+    # buffers — the cholinv design (models/cholesky.py): no per-level
+    # jnp.block assembly, and both trmms skip the triangular operand's dead
+    # blocks (pallas single-device; segment-skipping explicit mode on a mesh)
+    targs = dict(mode=cfg.mode)
+    M = summa.trmm(
+        grid, out, Tp,
+        TrmmArgs(side="R", uplo="L", precision=cfg.precision), **targs,
+        a_view=(off, off, n1, n1),          # L11inv
+        b_view=(off + n1, off, n2, n1),     # L21
+    )
+    out = summa.trmm(
+        grid, out, M,
+        TrmmArgs(side="L", uplo="L", alpha=-1.0, precision=cfg.precision),
+        **targs,
+        a_view=(off + n1, off + n1, n2, n2),  # L22inv
+        out=out, out_off=(off + n1, off),
+    )
+    return out
+
+
 @pallas_tpu.scoped_by_grid
 def rectri(
     grid: Grid,
@@ -57,7 +105,9 @@ def rectri(
     cfg: RectriConfig = RectriConfig(),
 ) -> jnp.ndarray:
     """Inverse of triangular T (the completed inverse::rectri::invoke,
-    reference rectri.hpp:60-99).  jit-friendly trace-time recursion."""
+    reference rectri.hpp:60-99).  jit-friendly trace-time recursion over a
+    flat output buffer (leaf trtri blocks and off-diagonal trmm panels are
+    written exactly once, in place on the pallas path)."""
     if uplo not in ("L", "U"):
         raise ValueError(f"uplo must be 'L' or 'U', got {uplo!r}")
     n = T.shape[0]
@@ -69,26 +119,15 @@ def rectri(
         # single recursion body (the reference instantiates both via policy).
         return summa.transpose(grid, rectri(grid, summa.transpose(grid, T), "L", cfg))
 
-    if n <= cfg.base_case_dim:
-        Tr = lax.with_sharding_constraint(T, grid.replicated_sharding())
-        return grid.pin(lapack.trtri(Tr, uplo="L"))
+    from capital_tpu.models.cholesky import pad_embed_identity, padded_dim
 
-    n1 = n // 2
-    L11inv = rectri(grid, T[:n1, :n1], "L", cfg)
-    L22inv = rectri(grid, T[n1:, n1:], "L", cfg)
-    # B21 = −L22⁻¹ · L21 · L11⁻¹  (the TODO sketch at rectri.hpp:70-99)
-    gargs = GemmArgs(precision=cfg.precision)
-    M = summa.gemm(grid, T[n1:, :n1], L11inv, args=gargs, mode=cfg.mode)
-    B21 = summa.gemm(
-        grid,
-        L22inv,
-        M,
-        args=GemmArgs(alpha=-1.0, precision=cfg.precision),
-        mode=cfg.mode,
-    )
-    zeros12 = jnp.zeros((n1, n - n1), dtype=T.dtype)
-    out = jnp.block([[L11inv, zeros12], [B21, L22inv]])
-    return grid.pin(out)
+    p = padded_dim(n, cfg.base_case_dim)
+    # embed diag(T, I): stays lower-triangular, inverts to diag(T⁻¹, I)
+    Tp = grid.pin(pad_embed_identity(T, n, p))
+    out = grid.pin(jnp.zeros((p, p), dtype=T.dtype))
+    out = _rectri_into(grid, Tp, out, 0, p, cfg)
+    out = grid.pin(out)
+    return out[:n, :n] if p != n else out
 
 
 @dataclasses.dataclass(frozen=True)

@@ -83,35 +83,67 @@ def solve(
             grid, summa.transpose(grid, A), B, side, "U" if lower else "L", False, cfg
         )
 
-    if n <= cfg.base_case_dim:
-        return _base_solve(grid, A, B, lower, left=(side == "L"))
+    # solved blocks land in a flat X buffer at their final offsets (no
+    # per-level concatenate assembly — the cholinv/rectri flat-buffer
+    # design); the updated right-hand sides still flow down as values,
+    # which is inherent to the substitution order.
+    X = grid.pin(jnp.zeros_like(B))
+    X = _solve_into(grid, A, B, X, 0, n, side, lower, cfg)
+    return grid.pin(X)
 
-    n1 = n // 2
-    A11 = A[:n1, :n1]
-    A22 = A[n1:, n1:]
+
+def _solve_into(
+    grid: Grid,
+    A: jnp.ndarray,
+    B: jnp.ndarray,
+    X: jnp.ndarray,
+    off: int,
+    size: int,
+    side: str,
+    lower: bool,
+    cfg: TrsmConfig,
+) -> jnp.ndarray:
+    """Solve the (off, off, size, size) window of tri(A) against the current
+    right-hand-side value B (already narrowed to this window's rows/cols),
+    writing the solution block into X at offset `off` along the solve axis.
+    Returns the updated X (consumed)."""
+
+    def _xwin(o: int, s: int) -> jnp.ndarray:
+        if side == "L":
+            return lax.slice(X, (o, 0), (o + s, X.shape[1]))
+        return lax.slice(X, (0, o), (X.shape[0], o + s))
+
+    def _put(Xbuf: jnp.ndarray, val: jnp.ndarray, o: int) -> jnp.ndarray:
+        at = (o, 0) if side == "L" else (0, o)
+        return lax.dynamic_update_slice(Xbuf, val.astype(Xbuf.dtype), at)
+
+    if size <= cfg.base_case_dim:
+        Tw = lax.slice(A, (off, off), (off + size, off + size))
+        return _put(X, _base_solve(grid, Tw, B, lower, left=(side == "L")), off)
+
+    n1 = size // 2
+    n2 = size - n1
+    o1, o2 = off, off + n1
     gargs = GemmArgs(alpha=-1.0, beta=1.0, precision=cfg.precision)
 
     if side == "L" and lower:
-        A21 = A[n1:, :n1]
-        X1 = solve(grid, A11, B[:n1, :], side, uplo, False, cfg)
-        B2 = summa.gemm(grid, A21, X1, B[n1:, :], gargs, mode=cfg.mode)
-        X2 = solve(grid, A22, B2, side, uplo, False, cfg)
+        A21 = lax.slice(A, (o2, o1), (o2 + n2, o1 + n1))
+        X = _solve_into(grid, A, B[:n1, :], X, o1, n1, side, lower, cfg)
+        B2 = summa.gemm(grid, A21, _xwin(o1, n1), B[n1:, :], gargs, mode=cfg.mode)
+        X = _solve_into(grid, A, B2, X, o2, n2, side, lower, cfg)
     elif side == "L" and not lower:
-        A12 = A[:n1, n1:]
-        X2 = solve(grid, A22, B[n1:, :], side, uplo, False, cfg)
-        B1 = summa.gemm(grid, A12, X2, B[:n1, :], gargs, mode=cfg.mode)
-        X1 = solve(grid, A11, B1, side, uplo, False, cfg)
+        A12 = lax.slice(A, (o1, o2), (o1 + n1, o2 + n2))
+        X = _solve_into(grid, A, B[n1:, :], X, o2, n2, side, lower, cfg)
+        B1 = summa.gemm(grid, A12, _xwin(o2, n2), B[:n1, :], gargs, mode=cfg.mode)
+        X = _solve_into(grid, A, B1, X, o1, n1, side, lower, cfg)
     elif side == "R" and lower:
-        A21 = A[n1:, :n1]
-        X2 = solve(grid, A22, B[:, n1:], side, uplo, False, cfg)
-        B1 = summa.gemm(grid, X2, A21, B[:, :n1], gargs, mode=cfg.mode)
-        X1 = solve(grid, A11, B1, side, uplo, False, cfg)
+        A21 = lax.slice(A, (o2, o1), (o2 + n2, o1 + n1))
+        X = _solve_into(grid, A, B[:, n1:], X, o2, n2, side, lower, cfg)
+        B1 = summa.gemm(grid, _xwin(o2, n2), A21, B[:, :n1], gargs, mode=cfg.mode)
+        X = _solve_into(grid, A, B1, X, o1, n1, side, lower, cfg)
     else:  # side == "R", upper
-        A12 = A[:n1, n1:]
-        X1 = solve(grid, A11, B[:, :n1], side, uplo, False, cfg)
-        B2 = summa.gemm(grid, X1, A12, B[:, n1:], gargs, mode=cfg.mode)
-        X2 = solve(grid, A22, B2, side, uplo, False, cfg)
-
-    axis = 0 if side == "L" else 1
-    X = jnp.concatenate([X1, X2], axis=axis)
-    return grid.pin(X)
+        A12 = lax.slice(A, (o1, o2), (o1 + n1, o2 + n2))
+        X = _solve_into(grid, A, B[:, :n1], X, o1, n1, side, lower, cfg)
+        B2 = summa.gemm(grid, _xwin(o1, n1), A12, B[:, n1:], gargs, mode=cfg.mode)
+        X = _solve_into(grid, A, B2, X, o2, n2, side, lower, cfg)
+    return X

@@ -522,7 +522,12 @@ def tri_matmul(
     call at cholinv's Schur sizes).  With beta != 0 the dead triangle of the
     result is UNDEFINED (live tiles are the only ones visited; on the
     misaligned materializing fallback it happens to hold beta*C) — callers
-    must read only the out_uplo triangle."""
+    must read only the out_uplo triangle.  Rounding is path-dependent for
+    mixed dtypes: the aligned kernel adds C onto the f32 accumulator before
+    the single output cast, while the misaligned fallback first rounds the
+    product to the operand dtype and then adds at the jnp-promoted dtype
+    (mode='xla' semantics) — the same call can differ by one bf16 ulp
+    depending on 128-alignment of the views."""
     if a_uplo is not None and b_uplo is not None:
         raise ValueError("at most one triangular operand")
     if out_uplo is not None and (a_uplo is not None or b_uplo is not None):

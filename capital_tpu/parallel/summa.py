@@ -19,12 +19,13 @@ Here the same capability is expressed two ways, selectable per call:
   way the reference's chunked pipeline does by hand.
 
 * ``mode='explicit'``: a shard_map kernel that owns the schedule exactly like
-  the reference owns its MPI calls: a step loop over K-panel broadcasts
-  (masked-psum bcast from the owning row/column — the collective analog of
-  MPI_Bcast from a root), local dot_general per step, K-steps partitioned
-  over the depth axis 'z' (the 2.5D flop split), and a final psum over 'z'
-  (the reference's MPI_Allreduce collect, summa.hpp:236).  This path is the
-  control knob for communication research and is benchmarked against 'xla'.
+  the reference owns its MPI calls: ring all_gathers realize the row/column
+  panel broadcasts (amortized — same (d-1)/d bytes as d ring bcasts, one
+  collective per operand per chunk), K-segments partitioned over the depth
+  axis 'z' (the 2.5D flop split), per-segment dead-block skipping for
+  triangular operands/outputs, and a chunked psum over 'z' (the reference's
+  MPI_Iallreduce collect, summa.hpp:236-248).  This path is the control
+  knob for communication research and is benchmarked against 'xla'.
 
 * ``mode='pallas'``: trmm/syrk route through the live-tile-enumerated Pallas
   kernels (ops/pallas_tpu.py), which skip the dead triangle's blocks on the
@@ -97,28 +98,69 @@ class SyrkArgs:
 
 
 def _explicit_matmul(
-    grid: Grid, A: jnp.ndarray, B: jnp.ndarray, precision: str | None = None
+    grid: Grid,
+    A: jnp.ndarray,
+    B: jnp.ndarray,
+    precision: str | None = None,
+    a_uplo: str | None = None,
+    b_uplo: str | None = None,
+    out_uplo: str | None = None,
 ) -> jnp.ndarray:
-    """C = A @ B with the explicit SUMMA step schedule on the d x d x c grid.
+    """C = A @ B with the explicit SUMMA schedule on the d x d x c grid.
 
-    Schedule (mirrors summa.hpp:177-249, re-expressed with axis collectives):
-      for step k in this layer's share of the d K-panels:
-        a_panel = bcast(A[:, k-panel] from grid column y==k)   # row comm bcast
-        b_panel = bcast(B[k-panel, :] from grid row x==k)      # column comm bcast
-        acc += a_panel @ b_panel                               # local gemm
-      C = psum(acc, 'z')                                       # depth collect
+    Schedule (the reference's distribute/compute/collect, summa.hpp:177-249,
+    re-expressed with the collectives TPU SPMD actually has):
 
-    Bcast-from-root is realized as psum of a root-masked operand — the
-    standard axis-collective encoding of MPI_Bcast.  K-steps are split
-    contiguously over the depth axis: layer z handles steps
-    [z*d/c, (z+1)*d/c), which is the 2.5D replication trade (topology.h:76-78
-    replication depth c).
+      c == 1:  a_row = all_gather(A block, 'y')   # the d per-step row-comm
+               b_col = all_gather(B block, 'x')   # Bcasts of summa.hpp:185-193
+               acc  += a_row @ b_col               # amortized into one ring
+                                                   # gather per operand: same
+                                                   # (d-1)/d * bytes as d ring
+                                                   # bcasts, 1 collective vs d
+      c  > 1:  for each of this layer's d/c K-steps:
+                 a_panel = psum(mask(y == k, A chunk), 'y')  # root bcast as
+                 b_panel = psum(mask(x == k, B chunk), 'x')  # masked psum
+                 acc += a_panel @ b_panel
+               # per-step bcasts move only the layer's 1/c of the panels —
+               # the 2.5D comm saving (topology.h:76-78); an amortized
+               # full-row gather here would pay c/2 x the bytes (masked psum
+               # costs 2x a ring bcast per panel, but c x fewer panels move).
+      C = psum(acc, 'z')                  # depth collect (summa.hpp:236)
 
-    With grid.num_chunks > 1 each K-panel's broadcast is further split into
-    that many K-slices — the reference's chunked Ibcast pipeline
-    (summa.hpp:196-215): each slice is an independent collective the
-    latency-hiding scheduler can overlap with the previous slice's local
-    matmul.  The chunk loop is unrolled at trace time (static shapes).
+    (A true per-step one-to-many broadcast has no native SPMD primitive, so
+    the two encodings above trade bytes against synchronization: the
+    amortized gather is ring-bcast-byte-optimal and wins whenever a layer
+    needs every panel (c == 1, and ties at c == 2); the masked psum pays 2x
+    per moved panel but scales with the depth split.  tracing.gemm_cost
+    prices whichever this function emits.)
+
+    K-segments are assigned to depth layers contiguously — layer z owns
+    segments [z*d/c, (z+1)*d/c).
+
+    With grid.num_chunks = q > 1 both gathers and the depth collect are
+    split into q independent slices — the reference's Ibcast/Iallreduce
+    pipeline (summa.hpp:196-215, 239-248): each slice is a separate
+    collective the latency-hiding scheduler can overlap with the previous
+    slice's local matmul, and peak memory for the gathered row/col drops by
+    q.  The chunk loop is unrolled at trace time (static shapes).
+
+    Triangular structure (the distributed dead-block saving, reference
+    summa.hpp:47-161 via local BLAS trmm/syrk):
+      a_uplo/b_uplo — the operand *as passed* is upper/lower triangular
+          (already masked by the caller); K-segments entirely inside its
+          dead triangle for this device's block row/column are skipped with
+          lax.cond, so the dead half of a distributed trmm never reaches
+          the MXU.  Volumetric flops drop ~2x; note the *critical path* is
+          still the fullest block row (block distribution does not load-
+          balance a triangle the way the reference's element-cyclic layout
+          does — that rebalancing is a layout choice, not a schedule one).
+      out_uplo — only that triangle of C is needed: devices whose C block
+          is entirely dead skip all local compute (syrk's saving; the
+          caller symmetrizes or reads the live triangle only).
+
+    Local accumulation is f32 for sub-f32 inputs (the pallas kernels'
+    accumulator discipline); each layer's partial is cast back to the wire
+    dtype before the depth psum, so collect bytes match the operand dtype.
     """
     d, c = grid.dx, grid.c
     if grid.dy != d:
@@ -131,13 +173,30 @@ def _explicit_matmul(
     if M % d or K % d or N % d:
         raise ValueError(f"global dims {(M, K, N)} must be divisible by d={d}")
 
-    steps_per_layer = d // c
+    spl = d // c  # K-segments owned by each depth layer
     q = max(1, grid.num_chunks)
-    if (K // d) % q:
-        raise ValueError(
-            f"num_chunks={q} must divide the local K panel extent {K // d}"
-        )
-    ck = K // d // q
+    lk = K // d  # local K extent (A cols = B rows per device)
+    if lk % q:
+        raise ValueError(f"num_chunks={q} must divide the local K extent {lk}")
+    w = lk // q  # K-slice width per chunk, per segment
+    mb, nb = M // d, N // d
+    wire_dtype = jnp.result_type(A, B)
+    acc_dtype = jnp.promote_types(wire_dtype, jnp.float32)
+
+    def _seg_live_a(xi, s, ch):
+        # A columns of (segment s, chunk ch): [s*lk + ch*w, +w); rows of this
+        # device's block: [xi*mb, +mb).  Live = intersects the stored triangle.
+        lo = s * lk + ch * w
+        if a_uplo == "U":
+            return xi * mb < lo + w  # ∃ row <= col
+        return (xi + 1) * mb - 1 >= lo  # 'L': ∃ row >= col
+
+    def _seg_live_b(yi, s, ch):
+        # B rows of (segment s, chunk ch); cols of this block: [yi*nb, +nb)
+        lo = s * lk + ch * w
+        if b_uplo == "U":
+            return lo < (yi + 1) * nb
+        return lo + w - 1 >= yi * nb
 
     def kernel(a, b):
         # a: (M/d, K/d) block at (x, y);  b: (K/d, N/d) block at (x, y)
@@ -145,20 +204,124 @@ def _explicit_matmul(
         yi = lax.axis_index("y")
         zi = lax.axis_index("z")
 
-        acc = jnp.zeros((a.shape[0], b.shape[1]), dtype=jnp.result_type(a, b))
-        for i in range(steps_per_layer):
-            k = zi * steps_per_layer + i
+        # every liveness test guards ONLY local matmuls, never a collective:
+        # the gathers run unconditionally on all devices (a collective under
+        # a device-varying cond would desynchronize the mesh)
+        out_live = None
+        if out_uplo is not None:
+            out_live = (
+                xi * mb < (yi + 1) * nb
+                if out_uplo == "U"
+                else (xi + 1) * mb - 1 >= yi * nb
+            )
+
+        def guarded(live, mm, *operands):
+            if live is None:
+                return mm()
+            # the zero branch must carry the same varying-manual-axes type as
+            # the matmul branch (cond requires equal output types under
+            # shard_map's replication checking): mark it varying over the
+            # union of the operands' axes
+            vma: set = set()
+            for r in operands:
+                vma |= set(jax.typeof(r).vma)
+            zeros = jnp.zeros((mb, nb), dtype=acc_dtype)
+            if vma:
+                zeros = lax.pcast(zeros, tuple(sorted(vma)), to="varying")
+            return lax.cond(live, mm, lambda: zeros)
+
+        def matmul_term(live, a_op, b_op):
+            return guarded(
+                live,
+                lambda: jnp.matmul(
+                    a_op, b_op, precision=precision,
+                    preferred_element_type=acc_dtype,
+                ),
+                a_op, b_op,
+            )
+
+        acc = jnp.zeros((mb, nb), dtype=acc_dtype)
+        if c == 1:
             for ch in range(q):
-                a_sl = a[:, ch * ck : (ch + 1) * ck]
-                b_sl = b[ch * ck : (ch + 1) * ck, :]
-                a_panel = lax.psum(
-                    jnp.where(yi == k, a_sl, jnp.zeros_like(a_sl)), "y"
+                # gathered chunk: segment-major — segment s holds global
+                # K-range [s*lk + ch*w, +w), contributed by device s of the
+                # gather axis; A's and B's segment decompositions of K match
+                # because the face is square
+                a_ch = lax.all_gather(
+                    a[:, ch * w : (ch + 1) * w], "y", axis=1, tiled=True
                 )
-                b_panel = lax.psum(
-                    jnp.where(xi == k, b_sl, jnp.zeros_like(b_sl)), "x"
+                b_ch = lax.all_gather(
+                    b[ch * w : (ch + 1) * w, :], "x", axis=0, tiled=True
                 )
-                acc = acc + jnp.matmul(a_panel, b_panel, precision=precision)
-        return lax.psum(acc, "z")
+                if a_uplo is None and b_uplo is None:
+                    acc = acc + matmul_term(out_live, a_ch, b_ch)
+                else:
+                    # triangular operand: per-segment liveness — dead
+                    # segments never reach the MXU (summa.hpp:47-161's
+                    # saving, at K-segment granularity)
+                    for s in range(d):
+                        a_s = lax.slice_in_dim(
+                            a_ch, s * w, (s + 1) * w, axis=1
+                        )
+                        b_s = lax.slice_in_dim(
+                            b_ch, s * w, (s + 1) * w, axis=0
+                        )
+                        live = None
+                        if a_uplo is not None:
+                            live = _seg_live_a(xi, s, ch)
+                        if b_uplo is not None:
+                            lb = _seg_live_b(yi, s, ch)
+                            live = lb if live is None else jnp.logical_and(live, lb)
+                        if out_live is not None:
+                            live = (
+                                out_live
+                                if live is None
+                                else jnp.logical_and(live, out_live)
+                            )
+                        acc = acc + matmul_term(live, a_s, b_s)
+        else:
+            # per-step masked-psum broadcast of this layer's own d/c panels
+            # (the 2.5D comm saving); the liveness conds still skip the
+            # matmul of dead panels, but the bcast itself is unconditional
+            for i in range(spl):
+                k = zi * spl + i  # traced: the layer's i-th global K-step
+                for ch in range(q):
+                    a_sl = a[:, ch * w : (ch + 1) * w]
+                    b_sl = b[ch * w : (ch + 1) * w, :]
+                    a_panel = lax.psum(
+                        jnp.where(yi == k, a_sl, jnp.zeros_like(a_sl)), "y"
+                    )
+                    b_panel = lax.psum(
+                        jnp.where(xi == k, b_sl, jnp.zeros_like(b_sl)), "x"
+                    )
+                    live = None
+                    if a_uplo is not None:
+                        live = _seg_live_a(xi, k, ch)
+                    if b_uplo is not None:
+                        lb = _seg_live_b(yi, k, ch)
+                        live = lb if live is None else jnp.logical_and(live, lb)
+                    if out_live is not None:
+                        live = (
+                            out_live
+                            if live is None
+                            else jnp.logical_and(live, out_live)
+                        )
+                    acc = acc + matmul_term(live, a_panel, b_panel)
+
+        part = acc.astype(wire_dtype)  # collect in the wire dtype
+        if c == 1:
+            return part
+        # chunked depth collect (the reference's Iallreduce slices,
+        # summa.hpp:239-248): q independent psums over column slices —
+        # uneven widths when q does not divide the block, so the emitted
+        # collective count always matches the cost model's q
+        widths = [nb // q + (1 if j < nb % q else 0) for j in range(q)]
+        pieces, off = [], 0
+        for wd in widths:
+            if wd:
+                pieces.append(lax.psum(part[:, off : off + wd], "z"))
+                off += wd
+        return pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces, axis=1)
 
     return jax.shard_map(
         kernel,
@@ -179,7 +342,17 @@ def _matmul(
     B: jnp.ndarray,
     mode: str,
     precision: str | None = None,
+    a_uplo: str | None = None,
+    b_uplo: str | None = None,
+    out_uplo: str | None = None,
 ) -> jnp.ndarray:
+    """The uplo flags describe triangular structure of the (already masked)
+    operands/result; only mode='explicit' exploits them (dead K-segments /
+    dead output blocks skipped per device).  Emitted model flops stay the
+    dense count: with block distribution the *critical-path* device still
+    executes a full contraction (see _explicit_matmul docstring) — the
+    skipping is a volumetric saving the one-number-per-phase model does not
+    track."""
     # cost-model attribution (no-op without an active tracing.Recorder)
     flops, comm, ncoll = tracing.gemm_cost(
         grid, A.shape[0], B.shape[1], A.shape[1], jnp.result_type(A, B)
@@ -188,7 +361,7 @@ def _matmul(
     if mode in ("xla", "pallas"):  # gemm has no dead blocks: XLA is optimal
         return grid.pin(jnp.matmul(grid.pin(A), grid.pin(B), precision=precision))
     if mode == "explicit":
-        return _explicit_matmul(grid, A, B, precision)
+        return _explicit_matmul(grid, A, B, precision, a_uplo, b_uplo, out_uplo)
     raise ValueError(f"unknown summa mode {mode!r}")
 
 
@@ -274,10 +447,16 @@ def trmm(
     if args.diag == "U":
         T = masking.with_unit_diagonal(T)
     Top = T.T if args.trans_a else T
+    # structure of the operand *as passed* to the schedule: transposing a
+    # triangular matrix flips its triangle — explicit mode uses this to skip
+    # dead K-segments per device (summa.hpp:47-161's trmm saving)
+    eff_uplo = (
+        args.uplo if not args.trans_a else ("L" if args.uplo == "U" else "U")
+    )
     if args.side == "L":
-        res = _matmul(grid, Top, Bw, mode, args.precision)
+        res = _matmul(grid, Top, Bw, mode, args.precision, a_uplo=eff_uplo)
     elif args.side == "R":
-        res = _matmul(grid, Bw, Top, mode, args.precision)
+        res = _matmul(grid, Bw, Top, mode, args.precision, b_uplo=eff_uplo)
     else:
         raise ValueError(f"side must be 'L' or 'R', got {args.side!r}")
     if args.alpha != 1.0:
@@ -337,7 +516,21 @@ def syrk(
         )
     Aw = _take_view(A, a_view)
     Aop = (Aw.T, Aw) if args.trans else (Aw, Aw.T)
-    out = _matmul(grid, Aop[0], Aop[1], mode, args.precision)
+    if mode == "explicit":
+        # compute only the args.uplo triangle's blocks (devices with a fully
+        # dead C block skip all local flops), then symmetrize — one grid
+        # transpose, the same data motion the reference's syrk-via-transpose
+        # already pays (summa.hpp:86-161); the dense-result contract of this
+        # mode is preserved
+        D = _matmul(
+            grid, Aop[0], Aop[1], mode, args.precision, out_uplo=args.uplo
+        )
+        if args.uplo == "U":
+            out = jnp.triu(D) + transpose(grid, jnp.triu(D, 1))
+        else:
+            out = jnp.tril(D) + transpose(grid, jnp.tril(D, -1))
+    else:
+        out = _matmul(grid, Aop[0], Aop[1], mode, args.precision)
     if args.alpha != 1.0:
         out = args.alpha * out
     if args.beta != 0.0:

@@ -81,7 +81,13 @@ def _order_devices(
 
       0  depth-fastest (reference layout 0: z = rank % c) — consecutive
          devices stack along the replication axis, so the depth allreduce
-         rides the shortest links.  The natural reshape.
+         rides the shortest links.  The natural reshape.  NOTE the face
+         orientation is transposed relative to the reference's coordinate
+         assignment (topology.h:81-83 is z-fastest, then x, then y; this
+         reshape is z, then y, then x): row- and column-broadcast locality
+         are swapped, so layout-sweep rows here are not directly comparable
+         against reference layout-0 data — compare 0 vs 1 vs 2 within this
+         framework only.
       1  face-fastest (reference layout 1 family) — consecutive devices tile
          the d x d face first; row/column bcasts get the short links, depth
          gets the long ones.

@@ -207,24 +207,40 @@ def gemm_cost(grid, M: int, N: int, K: int, dtype) -> tuple[float, float, int]:
     """(flops, comm_bytes, collectives) per device for a distributed matmul
     C[M,N] = A[M,K] @ B[K,N] under the SUMMA schedule on a dx x dy x c grid.
 
-    Models the explicit schedule (parallel/summa.py:_explicit_matmul; the
-    reference's summa.hpp:177-249): per K-step a row-axis bcast of an A block
-    and a column-axis bcast of a B block, d/c steps per depth layer, one
-    allreduce of the C block over depth.  The 'xla' mode compiles to a
-    schedule of the same family, so the model serves both.
+    Models exactly what the explicit schedule emits
+    (parallel/summa.py:_explicit_matmul).  c == 1: a ring all_gather of the
+    A block row over axis 'y' and of the B block column over axis 'x' —
+    byte-equal to the reference's d per-step ring Bcasts
+    (summa.hpp:185-193).  c > 1: per-step masked-psum broadcasts of only
+    this layer's d/c panels (2x ring-bcast bytes per panel, c-fold fewer
+    panels — the 2.5D comm saving), plus a ring allreduce of the C block
+    over depth (summa.hpp:236).  num_chunks splits each of these into that
+    many slice collectives (same bytes, more synchronization points — the
+    Ibcast/Iallreduce pipeline).  The 'xla' mode compiles to schedules of
+    the same family, so the model serves both.
     """
     dx, dy, c = grid.dx, grid.dy, grid.c
     item = jnp.dtype(dtype).itemsize
     p = dx * dy * c
     flops = 2.0 * M * N * K / p
+    q = max(1, getattr(grid, "num_chunks", 0))
     d = max(dx, dy)
-    steps = max(1, d // max(c, 1))
-    a_blk = (M / dx) * (K / d) * item
-    b_blk = (K / d) * (N / dy) * item
     c_blk = (M / dx) * (N / dy) * item
-    comm = steps * (_ring_bytes(a_blk, dy) + _ring_bytes(b_blk, dx))
+    if c == 1:
+        a_row = (M / dx) * K * item  # gathered block row per device
+        b_col = K * (N / dy) * item  # gathered block column per device
+        comm = _ring_bytes(a_row, dy) + _ring_bytes(b_col, dx)
+        ncoll = (q if dy > 1 else 0) + (q if dx > 1 else 0)
+    else:
+        steps = max(1, d // c)  # this layer's K-steps
+        a_pan = (M / dx) * (K / d) * item
+        b_pan = (K / d) * (N / dy) * item
+        comm = steps * (
+            _allreduce_bytes(a_pan, dy) + _allreduce_bytes(b_pan, dx)
+        )
+        ncoll = steps * ((q if dy > 1 else 0) + (q if dx > 1 else 0))
     comm += _allreduce_bytes(c_blk, c)
-    ncoll = (2 * steps if (dx > 1 or dy > 1) else 0) + (1 if c > 1 else 0)
+    ncoll += q if c > 1 else 0
     return flops, comm, ncoll
 
 

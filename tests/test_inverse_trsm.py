@@ -38,6 +38,28 @@ class TestRectri:
         Tinv = inverse.rectri(grid2x2x2, Td, "L", RectriConfig(base_case_dim=32))
         assert residual.inverse_residual(T, Tinv) < 1e-13
 
+    def test_pallas_mode_single_device(self):
+        # the flat-buffer recursion's in-place view writes (VERDICT r1 #8)
+        from capital_tpu.parallel.topology import Grid
+
+        g1 = Grid.square(c=1, devices=jax.devices("cpu")[:1])
+        T = jax.device_put(_tri(256, "L"), g1.face_sharding())
+        Tinv = jax.jit(
+            lambda t: inverse.rectri(
+                g1, t, "L", RectriConfig(base_case_dim=64, mode="pallas")
+            )
+        )(T)
+        assert residual.inverse_residual(T, Tinv) < 1e-13
+        Ti = np.asarray(Tinv)
+        np.testing.assert_allclose(Ti, np.tril(Ti), atol=1e-14)
+
+    def test_explicit_mode_mesh(self, grid2x2x2):
+        T = jax.device_put(_tri(128, "L"), grid2x2x2.face_sharding())
+        Tinv = inverse.rectri(
+            grid2x2x2, T, "L", RectriConfig(base_case_dim=32, mode="explicit")
+        )
+        assert residual.inverse_residual(T, Tinv) < 1e-13
+
     def test_bad_inputs(self, grid2x2x1):
         with pytest.raises(ValueError):
             inverse.rectri(grid2x2x1, jnp.zeros((4, 6)))

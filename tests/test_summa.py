@@ -186,3 +186,109 @@ class TestTopologyKnobs:
         A = _put(g, rand48.random(32, 32, key=8))
         with pytest.raises(ValueError, match="num_chunks"):
             summa.gemm(g, A, A, mode="explicit")
+
+
+class TestExplicitEmission:
+    """VERDICT r1 #5: the cost model must price what the explicit schedule
+    actually emits.  Lower the jitted kernel and compare the collectives in
+    the compiled HLO against tracing.gemm_cost."""
+
+    def test_allgather_shapes_and_bytes_match_model_c1(self):
+        import re
+
+        from capital_tpu.parallel.topology import Grid
+        from capital_tpu.utils import tracing
+
+        q = 2
+        g = Grid.rect(2, 2, 1, devices=jax.devices("cpu")[:4], num_chunks=q)
+        M, K, N = 32, 64, 16
+        A = _put(g, rand48.random(M, K, key=1))
+        B = _put(g, rand48.random(K, N, key=2))
+        txt = (
+            jax.jit(lambda a, b: summa.gemm(g, a, b, mode="explicit"))
+            .lower(A, B)
+            .compile()
+            .as_text()
+        )
+        ag_shapes = re.findall(r"= (\S+?)\{[^}]*\} all-gather", txt)
+        # c=1: one amortized gather per operand per chunk; no psum bcasts,
+        # no depth collect
+        assert len(ag_shapes) == 2 * q, ag_shapes
+        mb, nb, w = M // g.dx, N // g.dy, K // g.dy // q
+        expect_a = f"f64[{mb},{g.dy * w}]"
+        expect_b = f"f64[{g.dx * w},{nb}]"
+        assert sorted(ag_shapes) == sorted([expect_a] * q + [expect_b] * q)
+        assert len(re.findall(r"all-reduce\(", txt)) == 0
+
+        # gathered bytes == the model's ring terms exactly
+        item = 8
+        gathered = q * (mb * g.dy * w + g.dx * w * nb) * item
+        ring = (
+            tracing._ring_bytes((M / g.dx) * K * item, g.dy)
+            + tracing._ring_bytes(K * (N / g.dy) * item, g.dx)
+        )
+        assert ring == pytest.approx(gathered * (g.dy - 1) / g.dy)
+        _, comm, ncoll = tracing.gemm_cost(g, M, N, K, jnp.float64)
+        assert comm == pytest.approx(ring)
+        assert ncoll == 2 * q
+
+    def test_psum_bcast_path_matches_model_c2(self):
+        # c>1 keeps the per-step masked-psum broadcasts so each depth layer
+        # moves only its 1/c of the panels (the 2.5D comm saving) — the
+        # schedule must emit NO all-gathers, and the model prices psum pairs
+        # per step plus the chunked depth collect
+        import re
+
+        from capital_tpu.parallel.topology import Grid
+        from capital_tpu.utils import tracing
+
+        q = 2
+        g = Grid.square(c=2, num_chunks=q)
+        M, K, N = 32, 64, 16
+        A = _put(g, rand48.random(M, K, key=1))
+        B = _put(g, rand48.random(K, N, key=2))
+        txt = (
+            jax.jit(lambda a, b: summa.gemm(g, a, b, mode="explicit"))
+            .lower(A, B)
+            .compile()
+            .as_text()
+        )
+        assert len(re.findall(r"all-gather", txt)) == 0
+        assert len(re.findall(r"all-reduce\(", txt)) >= 1  # XLA may merge
+
+        item = 8
+        d, steps = g.dx, g.dx // g.c
+        a_pan = (M / d) * (K / d) * item
+        b_pan = (K / d) * (N / d) * item
+        c_blk = (M / d) * (N / d) * item
+        _, comm, ncoll = tracing.gemm_cost(g, M, N, K, jnp.float64)
+        assert comm == pytest.approx(
+            steps
+            * (
+                tracing._allreduce_bytes(a_pan, d)
+                + tracing._allreduce_bytes(b_pan, d)
+            )
+            + tracing._allreduce_bytes(c_blk, g.c)
+        )
+        assert ncoll == steps * 2 * q + q
+
+    def test_trmm_dead_segments_guarded(self, grid2x2x1):
+        # triangular-operand explicit schedule must emit per-segment
+        # conditionals (the dead-block skipping), and stay correct — value
+        # checks live in TestTrmm::test_variants
+        import re
+
+        g = grid2x2x1
+        A = _put(g, np.triu(rand48.random(32, 32, key=1)))
+        B = _put(g, rand48.random(32, 32, key=2))
+        txt = (
+            jax.jit(
+                lambda a, b: summa.trmm(
+                    g, a, b, TrmmArgs(side="L", uplo="U"), mode="explicit"
+                )
+            )
+            .lower(A, B)
+            .compile()
+            .as_text()
+        )
+        assert "conditional" in txt

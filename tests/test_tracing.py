@@ -27,10 +27,12 @@ def test_gemm_cost_arithmetic(grid2x2x2):
     flops, comm, ncoll = tracing.gemm_cost(grid2x2x2, M, N, K, jnp.float32)
     # flops split evenly over 8 devices
     assert flops == pytest.approx(2 * M * N * K / 8)
-    # d=2, c=2 -> 1 step/layer: one A-block ring bcast over dy=2, one B-block
-    # over dx=2, plus the z allreduce of the C block
-    a_blk = (M / 2) * (K / 2) * 4
-    expect = (a_blk * 0.5) * 2 + 2 * (M / 2) * (N / 2) * 4 * 0.5
+    # d=2, c=2: ring all_gather of the A block row over dy=2 and of the B
+    # block column over dx=2, plus the z allreduce of the C block — what
+    # _explicit_matmul emits (TestExplicitEmission checks against HLO)
+    a_row = (M / 2) * K * 4
+    b_col = K * (N / 2) * 4
+    expect = a_row * 0.5 + b_col * 0.5 + 2 * (M / 2) * (N / 2) * 4 * 0.5
     assert comm == pytest.approx(expect)
     assert ncoll == 3
 

@@ -86,65 +86,115 @@ class CacqrConfig:
 # --------------------------------------------------------------------------
 
 
+def _col_blocks(n: int) -> int:
+    """Column-block count for the triangular-blocked gram/scaling.  Fixed at
+    2 (or 1 = unblocked for small/unaligned n): these tall-skinny products
+    sit near the HBM roofline, and each extra split re-reads more of A —
+    measured on v5e at 1M x 1024 bf16, g=4 with per-block products cost 5x
+    the A traffic plus XLA relayout copies and ran 1.5x SLOWER than dense
+    (86 vs 57 ms/iter device time); g=2 over contiguous slabs is the only
+    split whose flop saving (25%) exceeds its traffic increase."""
+    if n % 2 == 0 and (n // 2) % 128 == 0 and n // 2 >= 256:
+        return 2
+    return 1
+
+
 def _sweep_1d(
     grid: Grid, A: jnp.ndarray, cfg: CacqrConfig
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """One CQR sweep, 1D regime (reference sweep_1d, cacqr.hpp:7-29).
 
-    A arrives sharded along rows over the whole mesh; the gram contraction
-    AᵀA is written globally and pinned replicated — XLA emits the local
-    partial product and the all-axis psum, the exact analog of the
-    reference's local syrk + MPI_Allreduce over world (cacqr.hpp:14-25).
+    A arrives sharded along rows over the whole mesh; gram contractions are
+    written globally and pinned replicated — XLA emits the local partial
+    product and the all-axis psum, the exact analog of the reference's
+    local syrk + MPI_Allreduce over world (cacqr.hpp:14-25).
 
-    On a single device with cfg.mode='pallas' both big contractions route
-    through the live-tile kernels — the reference's local cblas_dsyrk /
-    cblas_dtrmm flop savings (cacqr.hpp:14,25): the gram computes only the
-    upper triangle of AᵀA (~half the mn² flops) and Q = A·R⁻¹ skips R⁻¹'s
-    dead lower blocks; the Cholesky pair then reads only the gram's valid
-    upper triangle (potrf_trtri_upper).
+    The triangular flop savings of the reference's local cblas_dsyrk /
+    cblas_dtrmm (cacqr.hpp:14,25), measured into this shape on v5e at
+    1M x 1024 bf16 (the BASELINE-adjacent row):
+
+      * gram — **XLA-level row blocking**: G[i, i*nb:] = A_iᵀ · A[:, i*nb:]
+        computes only the upper block-rows off one contiguous trailing slab
+        per row (lower blocks are transposes, n x n elementwise);
+        (g+1)/2g of dense flops at minimum extra A-traffic.
+      * scaling — Q = A·R⁻¹ through the live-tile trmm kernel with column
+        blocks sized to the triangle (bn = bk = n/g): 3/4 executed flops at
+        g=2, output written once, row-major, no assembly.
+
+    Rejected alternatives, with v5e measurements: per-256-block XLA
+    products (5x A traffic + whole-Q relayout copies: 86 ms/iter vs dense
+    57), column-slab threading between sweeps (XLA assigns the slabs mixed
+    layouts and re-layouts the assembled Q: ~13 ms/iter of copies), and
+    default-block pallas routing (an n=1024 triangle is a single tile at
+    deep-K defaults — no skipping happens, 76 ≈ 78 TF/s dense).
     """
     m, n = A.shape
     precision = cfg.precision
-    use_pallas = cfg.mode == "pallas" and grid.num_devices == 1
+    g = _col_blocks(n)
+    nb = n // g
     A = lax.with_sharding_constraint(A, grid.rows_sharding())
+    live_frac = (g + 1) / (2.0 * g) if g > 1 else 1.0
     # phase tags follow the reference symbols CQR::gram / CQR::formR
     # (cacqr.hpp:82-116)
     with tracing.scope("CQR::gram"):
-        if use_pallas:
-            # summa.syrk emits its own (halved) cost attribution
-            G = summa.syrk(
-                grid, A,
-                args=SyrkArgs(trans=True, precision=precision), mode="pallas",
+        comm, ncoll = tracing.allreduce_cost(grid, n, n, A.dtype, axes="all")
+        tracing.emit(
+            flops=2.0 * m * n * n / grid.num_devices * live_frac,
+            comm_bytes=comm * live_frac,
+            collectives=ncoll,
+        )
+        if g > 1:
+            grows = [
+                jnp.matmul(
+                    A[:, i * nb : (i + 1) * nb].T,
+                    A[:, i * nb :],
+                    precision=precision,
+                )
+                for i in range(g)
+            ]
+            G = jnp.concatenate(
+                [
+                    jnp.concatenate(
+                        [
+                            grows[j][:, (i - j) * nb : (i - j + 1) * nb].T
+                            for j in range(i)
+                        ]
+                        + [grows[i]],
+                        axis=1,
+                    )
+                    for i in range(g)
+                ],
+                axis=0,
             )
         else:
-            comm, ncoll = tracing.allreduce_cost(grid, n, n, A.dtype, axes="all")
-            tracing.emit(
-                flops=2.0 * m * n * n / grid.num_devices,
-                comm_bytes=comm, collectives=ncoll,
-            )
-            G = lax.with_sharding_constraint(
-                jnp.matmul(A.T, A, precision=precision),
-                grid.replicated_sharding(),
-            )
+            G = jnp.matmul(A.T, A, precision=precision)
+        G = lax.with_sharding_constraint(G, grid.replicated_sharding())
     with tracing.scope("CQR::chol"):
         tracing.emit(flops=tracing.potrf_trtri_flops(n))
-        if use_pallas:
-            # the pallas syrk left the gram's lower half dead/undefined
-            R, Rinv = lapack.potrf_trtri_upper(G)
-        else:
-            R, Rinv = lapack.potrf_trtri(G, uplo="U")
+        R, Rinv = lapack.potrf_trtri(G, uplo="U")
     with tracing.scope("CQR::formR"):
-        if use_pallas:
-            Q = summa.trmm(
-                grid, Rinv, A,
-                TrmmArgs(side="R", uplo="U", precision=precision),
-                mode="pallas",
+        tri_kernel = g > 1 and grid.num_devices == 1
+        # live_frac applies only where the tri kernel actually skips dead
+        # blocks; the multi-device path executes the dense matmul
+        tracing.emit(
+            flops=2.0 * m * n * n / grid.num_devices
+            * (live_frac if tri_kernel else 1.0)
+        )
+        if tri_kernel:
+            # live-tile trmm with triangle-sized column blocks (bn = bk =
+            # n/g); bm capped at the kernel's large-tile budget.  Measured
+            # at 1M x 1024 bf16 on v5e (device-trace kernel totals/sweep):
+            # 512 blocks 10.7 ms (3/4 executed at 154 TF/s), 256 blocks
+            # 13.9 ms (5/8 executed but per-tile efficiency collapses) —
+            # finer blocks lose more to tile overhead than they save in
+            # dead flops
+            bm = min(1024, pallas_tpu._round_up(m, 128))
+            Q = pallas_tpu.tri_matmul(
+                A, Rinv, b_uplo="U", blocks=(bm, nb, nb), precision=precision
             )
         else:
-            tracing.emit(flops=2.0 * m * n * n / grid.num_devices)
-            Q = lax.with_sharding_constraint(
-                jnp.matmul(A, Rinv, precision=precision), grid.rows_sharding()
-            )
+            Q = jnp.matmul(A, jnp.triu(Rinv), precision=precision)
+        Q = lax.with_sharding_constraint(Q, grid.rows_sharding())
     return Q, R
 
 
@@ -246,25 +296,24 @@ def factor(
     if cfg.num_iter not in (1, 2):
         raise ValueError(f"num_iter must be 1 (CQR) or 2 (CQR2), got {cfg.num_iter}")
     regime = _pick_regime(grid, n, cfg)
-    sweep = (
-        (lambda a: _sweep_1d(grid, a, cfg))
-        if regime == "1d"
-        else (lambda a: _sweep_dist(grid, a, cfg))
-    )
-    Q, R = sweep(A)
-    if cfg.num_iter == 2:
-        Q, R2 = sweep(Q)
-        # merge R = R2 · R1: both upper triangular; small local/distributed trmm
-        # (reference cacqr.hpp:181-189, 204-210)
-        with tracing.scope("CQR::merge"):
-            if regime == "1d":
+    if regime == "1d":
+        Q, R = _sweep_1d(grid, A, cfg)
+        if cfg.num_iter == 2:
+            Q, R2 = _sweep_1d(grid, Q, cfg)
+            with tracing.scope("CQR::merge"):
                 tracing.emit(flops=2.0 * R.shape[0] ** 3)
                 R = jnp.matmul(jnp.triu(R2), jnp.triu(R), precision=cfg.precision)
-            else:
-                R = summa.trmm(
-                    grid, R2, R,
-                    TrmmArgs(side="L", uplo="U", precision=cfg.precision), mode=cfg.mode,
-                )
+        return Q, R
+    Q, R = _sweep_dist(grid, A, cfg)
+    if cfg.num_iter == 2:
+        Q, R2 = _sweep_dist(grid, Q, cfg)
+        # merge R = R2 · R1: both upper triangular; small distributed trmm
+        # (reference cacqr.hpp:181-189, 204-210)
+        with tracing.scope("CQR::merge"):
+            R = summa.trmm(
+                grid, R2, R,
+                TrmmArgs(side="L", uplo="U", precision=cfg.precision), mode=cfg.mode,
+            )
     return Q, R
 
 

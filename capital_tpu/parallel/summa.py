@@ -313,8 +313,9 @@ def _explicit_matmul(
             return part
         # chunked depth collect (the reference's Iallreduce slices,
         # summa.hpp:239-248): q independent psums over column slices —
-        # uneven widths when q does not divide the block, so the emitted
-        # collective count always matches the cost model's q
+        # uneven widths when q does not divide the block; zero-width tails
+        # (q > nb) are skipped, so min(q, nb) psums are emitted, which is
+        # what tracing.gemm_cost counts
         widths = [nb // q + (1 if j < nb % q else 0) for j in range(q)]
         pieces, off = [], 0
         for wd in widths:
@@ -544,4 +545,6 @@ def transpose(grid: Grid, A: jnp.ndarray) -> jnp.ndarray:
     Reference util::transpose swaps blocks with the mirrored grid rank via
     MPI_Sendrecv_replace (util.hpp:232-247); on TPU the same data motion is
     XLA's collective-permute, emitted from the layout constraint."""
+    comm, ncoll = tracing.transpose_cost(grid, A.shape[0], A.shape[1], A.dtype)
+    tracing.emit(comm_bytes=comm, collectives=ncoll)
     return grid.pin(A.T)

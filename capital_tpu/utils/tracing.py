@@ -240,8 +240,22 @@ def gemm_cost(grid, M: int, N: int, K: int, dtype) -> tuple[float, float, int]:
         )
         ncoll = steps * ((q if dy > 1 else 0) + (q if dx > 1 else 0))
     comm += _allreduce_bytes(c_blk, c)
-    ncoll += q if c > 1 else 0
+    # the collect splits into q column slices, but never more than the
+    # block has columns (zero-width tails are skipped by the schedule)
+    ncoll += min(q, max(1, int(N // max(1, dy)))) if c > 1 else 0
     return flops, comm, ncoll
+
+
+def transpose_cost(grid, m: int, n: int, dtype) -> tuple[float, int]:
+    """(comm_bytes, collectives) per device for a grid transpose: each
+    device exchanges its (m/dx, n/dy) block with the mirrored coordinate —
+    the reference's pairwise MPI_Sendrecv_replace (util.hpp:232-247), on
+    TPU a collective-permute emitted from the layout constraint."""
+    dx, dy = grid.dx, grid.dy
+    if dx == 1 and dy == 1:
+        return 0.0, 0
+    item = jnp.dtype(dtype).itemsize
+    return (m / dx) * (n / dy) * item, 1
 
 
 def replicate_cost(grid, m: int, n: int, dtype) -> tuple[float, int]:

@@ -106,10 +106,37 @@ class TestApply:
             qr.factor(grid_flat8, _tall(64, 16), CacqrConfig(num_iter=3))
 
 
-class TestSweep1DPallas:
-    """VERDICT r1 #3: the 1d sweep's gram/scaling route through the
-    live-tile syrk/trmm kernels on a single device (mode='pallas') — the
-    reference's local cblas_dsyrk/dtrmm flop savings (cacqr.hpp:14,25)."""
+class TestSweep1DBlocked:
+    """VERDICT r1 #3: the 1d sweep's triangular flop savings.  Implemented
+    as XLA-level column blocking (upper gram blocks only; Q_j skips R-inv's
+    dead lower blocks) — tile-level pallas skipping measured neutral at
+    these shapes (see _sweep_1d docstring).  Mode no longer changes the 1d
+    sweep; the mode-equality tests below guard exactly that."""
+
+    def test_blocked_matches_unblocked(self, monkeypatch):
+        # n=512 engages g=2 column blocking; forcing g=1 must give the
+        # same factorization to fp roundoff (same per-element K order)
+        g1 = Grid.square(c=1, devices=jax.devices("cpu")[:1])
+        A = _tall(2048, 512).astype(jnp.float64)
+        assert qr._col_blocks(512) == 2
+        Qb, Rb = qr.factor(g1, A, CacqrConfig(num_iter=2, regime="1d"))
+        monkeypatch.setattr(qr, "_col_blocks", lambda n: 1)
+        Qu, Ru = qr.factor(g1, A, CacqrConfig(num_iter=2, regime="1d"))
+        np.testing.assert_allclose(np.asarray(Qb), np.asarray(Qu), atol=1e-12)
+        np.testing.assert_allclose(
+            np.triu(np.asarray(Rb)), np.triu(np.asarray(Ru)), atol=1e-10
+        )
+        assert float(residual.qr_orthogonality(Qb)) < 1e-14
+        assert float(residual.qr_residual(A, Qb, Rb)) < 1e-13
+
+    def test_blocked_distributed(self, grid_flat8):
+        g = grid_flat8
+        A = jax.device_put(_tall(1024, 512), g.rows_sharding())
+        Q, R = jax.jit(
+            lambda a: qr.factor(g, a, CacqrConfig(num_iter=2, regime="1d"))
+        )(A)
+        assert float(residual.qr_orthogonality(Q)) < 1e-14
+        assert float(residual.qr_residual(A, Q, R)) < 1e-13
 
     def test_pallas_matches_xla_1d(self):
         g1 = Grid.square(c=1, devices=jax.devices("cpu")[:1])

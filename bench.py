@@ -21,7 +21,7 @@ the iteration loop runs INSIDE one jit (lax.fori_loop with a data-dependent
 carry), the result is synced by a host transfer, and the per-iteration time
 is the delta between an (ITERS+1)-iteration run and a 1-iteration run.
 
-Usage: python bench.py [N] [dtype] [iters]
+Usage: python bench.py [N] [dtype] [iters] [base_case_dim]
 """
 
 from __future__ import annotations
@@ -89,11 +89,25 @@ def main() -> None:
     dev = jax.devices()[0]
     grid = Grid.square(c=1, devices=[dev])
 
+    # base case: 512 is the committed sweet spot; for n that 512 cannot
+    # tile exactly (the aligned pallas path needs n = bc * 2^k), fall back
+    # to the largest 128-multiple that does rather than padding — at
+    # n=49152 a 512 base would pad to 65536 (1.8x the flops and an OOM)
+    bc = int(sys.argv[4]) if len(sys.argv) > 4 else 0
+    if not bc:
+        # candidates must be 128-multiples: the pallas view path needs every
+        # window offset 128-aligned (ops/pallas_tpu._fit_block)
+        for cand in (512, 384, 256):
+            if cholesky.padded_dim(n, cand) == n:
+                bc = cand
+                break
+        else:
+            bc = 512
     # bf16 throughput config: trailing updates at the MXU's native precision
     # through the pallas dead-block-skipping kernels, base case in f32
     # (CholinvConfig default picks f32 for narrow inputs)
     cfg = cholesky.CholinvConfig(
-        base_case_dim=512,
+        base_case_dim=bc,
         mode="pallas",
         precision=None if jnp.dtype(dtype).itemsize < 4 else "highest",
     )
@@ -169,6 +183,7 @@ def main() -> None:
                 "unit": "TFLOP/s",
                 "vs_baseline": round(tflops / target, 4),
                 "n": n,
+                "bc": bc,
                 "dtype": str(jnp.dtype(dtype)),
                 "seconds": round(t, 4),
                 "device": dev.device_kind,

@@ -16,6 +16,17 @@ def main(argv=None) -> None:
     p.add_argument("--out", default="autotune_out")
     p.add_argument("--bc", type=int, nargs="+", default=None)
     p.add_argument(
+        "--modes", nargs="+", default=None,
+        choices=["xla", "explicit", "pallas"],
+        help="cholinv: SUMMA modes to sweep (the winning flagship config is "
+        "pallas on one TPU — a sweep that cannot reach it is useless)",
+    )
+    p.add_argument("--splits", type=int, nargs="+", default=None)
+    p.add_argument(
+        "--policies", nargs="+", default=None,
+        help="cholinv: BaseCasePolicy names (e.g. REPLICATE_COMM_COMP)",
+    )
+    p.add_argument(
         "--top-k", type=int, default=0,
         help="cholinv: measure only the native planner's top-k model candidates",
     )
@@ -53,6 +64,16 @@ def main(argv=None) -> None:
     dtype = jnp.dtype(args.dtype)
     space = {"bc_dims": tuple(args.bc)} if args.bc else {}
     if args.alg == "cholinv":
+        # these knobs exist only in the cholinv space (cacqr sweeps
+        # variant x bc x regime)
+        if args.modes:
+            space["modes"] = tuple(args.modes)
+        if args.splits:
+            space["splits"] = tuple(args.splits)
+        if args.policies:
+            from capital_tpu.utils.config import BaseCasePolicy
+
+            space["policies"] = tuple(BaseCasePolicy[p] for p in args.policies)
         grid = Grid.square(c=1, devices=dev)
         res = sweep.tune_cholinv(
             grid, args.n, dtype, args.out, prefilter_top_k=args.top_k,

@@ -140,8 +140,10 @@ def _sweep_1d(
         comm, ncoll = tracing.allreduce_cost(grid, n, n, A.dtype, axes="all")
         tracing.emit(
             flops=2.0 * m * n * n / grid.num_devices * live_frac,
+            # blocked gram: one psum per block-row product of live_frac of
+            # the n x n bytes in total (g collectives, not one)
             comm_bytes=comm * live_frac,
-            collectives=ncoll,
+            collectives=ncoll * (g if g > 1 else 1),
         )
         if g > 1:
             grows = [

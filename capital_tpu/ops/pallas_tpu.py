@@ -191,6 +191,12 @@ def default_blocks(
     dtype_bk = 2048 if itemsize <= 2 else 1024
     if tri_operand:
         dtype_bk //= 2
+        # window-adaptive depth: the masked band costs ~bk/2k of executed
+        # flops, so small-K windows (cholinv's deep recursion levels, which
+        # run at 50-85 TF/s useful vs 151-165 at L0) take finer K; k//4
+        # caps the band waste at ~12.5% while leaving every window >= 4096
+        # at the measured-optimal 1024 depth
+        dtype_bk = min(dtype_bk, max(256, _round_up(k, 128) // 512 * 128))
     bk = max(128, min(dtype_bk, _round_up(k, 128)))
     return bm, bn, bk
 

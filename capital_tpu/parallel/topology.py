@@ -259,9 +259,23 @@ class Grid:
     def pin(self, x):
         """Constrain a 2D array to the face layout when its shape divides the
         face, else leave placement to XLA (uneven explicit shardings are
-        rejected by jit; odd-sized recursion windows hit this)."""
+        rejected by jit; odd-sized recursion windows hit this).  The fallback
+        is announced — a distributed run with a misaligned n would otherwise
+        silently lose the intended layout (pad to a divisible size upstream
+        to avoid it)."""
         if x.ndim == 2 and x.shape[0] % self.dx == 0 and x.shape[1] % self.dy == 0:
             return jax.lax.with_sharding_constraint(x, self.face_sharding())
+        if self.num_devices > 1:
+            from capital_tpu.utils import tracing
+
+            tracing.note("pin::fallback")
+            import warnings
+
+            warnings.warn(
+                f"Grid.pin: shape {tuple(x.shape)} does not divide the "
+                f"{self.dx}x{self.dy} face; placement left to XLA",
+                stacklevel=2,
+            )
         return x
 
     # ---- shape utilities ---------------------------------------------------

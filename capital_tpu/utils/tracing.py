@@ -145,6 +145,14 @@ def emit(flops: float = 0.0, comm_bytes: float = 0.0, collectives: int = 0) -> N
         st.collectives += collectives
 
 
+def note(tag: str) -> None:
+    """Count-only event under its own tag (not the scope stack) — used for
+    trace-time telemetry like layout-fallback occurrences.  No-op without an
+    active Recorder."""
+    for rec in _ACTIVE:
+        rec.stats[tag].calls += 1
+
+
 class Recorder:
     """Collects per-phase model costs during one tracing pass.
 

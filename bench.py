@@ -103,6 +103,13 @@ def main() -> None:
                 break
         else:
             bc = 512
+            print(
+                f"# warning: no 128-multiple base tiles n={n} exactly; "
+                f"padding to {cholesky.padded_dim(n, bc)} "
+                f"({cholesky.padded_dim(n, bc)**3 / n**3:.2f}x the flops — "
+                "pick n = bc * 2^k to avoid this)",
+                file=sys.stderr,
+            )
     # bf16 throughput config: trailing updates at the MXU's native precision
     # through the pallas dead-block-skipping kernels, base case in f32
     # (CholinvConfig default picks f32 for narrow inputs)

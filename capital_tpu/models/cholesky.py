@@ -46,6 +46,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.sharding import PartitionSpec as P
 
 from capital_tpu.ops import lapack, pallas_tpu
 from capital_tpu.parallel import summa
@@ -172,10 +173,11 @@ def _base_case_into(
     reading the window (off, off, n, n) of `buf` (upper triangle valid) and
     writing the R / R⁻¹ blocks into Rp / RIp at diagonal offset `dest`.
 
-    REPLICATE_* policies pin the panel replicated (XLA emits one all_gather
-    over the mesh; every chip factors the panel redundantly — the TPU-optimal
-    choice).  NO_REPLICATION_* leaves placement to the SPMD partitioner, the
-    analog of the reference's root-rank strategies.
+    The panel is replicated (XLA emits one all_gather over the mesh); which
+    devices then FACTOR it is the policy (see _scoped_base_factor): every
+    chip redundantly (REPLICATE_COMM_COMP, the TPU-optimal default), the
+    z=0 layer + depth broadcast (REPLICATE_COMP), or the root device + mesh
+    broadcast (NO_REPLICATION[_OVERLAP]).
 
     Single-device path: the window read, the symmetric-panel rebuild, and
     both output writes run through the layout-opaque Pallas transpose kernel
@@ -189,11 +191,17 @@ def _base_case_into(
         bc_dtype = buf.dtype if jnp.dtype(buf.dtype).itemsize >= 4 else jnp.float32
     # phase tag CI::factor_diag (reference cholinv.hpp:94-99)
     with tracing.scope("CI::factor_diag"):
-        comm, ncoll = (
-            (0.0, 0)
-            if cfg.policy.single_device_compute
-            else tracing.replicate_cost(grid, n, n, bc_dtype)
-        )
+        scope_ = cfg.policy.compute_scope
+        comm, ncoll = tracing.replicate_cost(grid, n, n, bc_dtype)
+        if grid.num_devices > 1 and scope_ != "all":
+            # result broadcast: psum of the masked pair over 'z' (layer) or
+            # the whole mesh (root)
+            p = grid.c if scope_ == "layer" else grid.num_devices
+            bcomm, bcoll = tracing.allreduce_cost(
+                grid, n, n, bc_dtype, axes="z" if scope_ == "layer" else "all"
+            )
+            if p > 1:
+                comm, ncoll = comm + 2 * bcomm, ncoll + 2 * bcoll
         tracing.emit(
             flops=tracing.potrf_trtri_flops(n), comm_bytes=comm, collectives=ncoll
         )
@@ -213,12 +221,73 @@ def _base_case_into(
             )
             return Rp, RIp
         window = lax.slice(buf, (off, off), (off + n, off + n)).astype(bc_dtype)
-        if not cfg.policy.single_device_compute:
-            window = lax.with_sharding_constraint(window, grid.replicated_sharding())
-        R, Rinv = lapack.potrf_trtri_upper(window)
+        window = lax.with_sharding_constraint(window, grid.replicated_sharding())
+        R, Rinv = _scoped_base_factor(grid, window, scope_)
         Rp = lax.dynamic_update_slice(Rp, R.astype(Rp.dtype), (dest, dest))
         RIp = lax.dynamic_update_slice(RIp, Rinv.astype(RIp.dtype), (dest, dest))
         return grid.pin(Rp), grid.pin(RIp)
+
+
+def _scoped_base_factor(
+    grid: Grid, window: jnp.ndarray, scope_: str
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """potrf+trtri of a replicated panel, executed by the devices the policy
+    names (reference cholinv policy.h:160-514):
+
+      'all'   — every device factors redundantly (no further collective)
+      'layer' — only the z=0 depth layer factors; the pair is broadcast down
+                'z' as a psum of the layer-masked value (≙ the reference's
+                MPI_Bcast over the depth comm, policy.h:288-305)
+      'root'  — only device (0,0,0) factors; the pair is broadcast over the
+                whole mesh (≙ gather-to-root compute + scatter + bcast,
+                policy.h:307-414; the OVERLAP variant's hand-rolled
+                communication/compute overlap belongs to XLA's scheduler)
+
+    The cond guards only local compute, never a collective; the zero branch
+    is pcast to the varying type the psum needs.
+    """
+    if scope_ == "all" or grid.num_devices == 1 or (
+        scope_ == "layer" and grid.c == 1
+    ):
+        return lapack.potrf_trtri_upper(window)
+
+    axes = ("z",) if scope_ == "layer" else ("x", "y", "z")
+
+    def kernel(w):
+        on = jnp.asarray(True)
+        for a in axes:
+            on = jnp.logical_and(on, lax.axis_index(a) == 0)
+
+        def compute():
+            # no pallas inside the shard_map body (vma annotations) — the
+            # panel is a small replicated bc x bc block, so the jnp-level
+            # symmetrize is fine here
+            from capital_tpu.ops import masking
+
+            R, Rinv = lapack.potrf_trtri(
+                masking.symmetrize_from(w, "U"), uplo="U"
+            )
+            return (
+                lax.pcast(R, axes, to="varying"),
+                lax.pcast(Rinv, axes, to="varying"),
+            )
+
+        def zeros():
+            z = jnp.zeros_like(w)
+            return (
+                lax.pcast(z, axes, to="varying"),
+                lax.pcast(z, axes, to="varying"),
+            )
+
+        R, Rinv = lax.cond(on, compute, zeros)
+        return lax.psum(R, axes), lax.psum(Rinv, axes)
+
+    return jax.shard_map(
+        kernel,
+        mesh=grid.mesh,
+        in_specs=P(),
+        out_specs=(P(), P()),
+    )(window)
 
 
 def _recurse(

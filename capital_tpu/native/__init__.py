@@ -275,15 +275,27 @@ def _predict_py(n, dx, dy, c, peak, bw, alpha, item, bc, pol, split, complete_in
     def ring(b, p):
         return b * (p - 1) / p if p > 1 else 0.0
 
+    def allred(b, p):
+        return 2.0 * b * (p - 1) / p if p > 1 else 0.0
+
     def gemm(M, N, K, tri=0.5):
+        # mirrors tracing.gemm_cost: c==1 amortized ring all_gathers;
+        # c>1 per-step masked-psum broadcasts of the layer's d/c panels
         p = dx * dy * c
         d = max(dx, dy)
-        steps = max(1, d // max(c, 1))
         fl = tri * 2.0 * M * N * K / p
-        comm = steps * (
-            ring(M / dx * K / d * item, dy) + ring(K / d * N / dy * item, dx)
-        ) + (2.0 * M / dx * N / dy * item * (c - 1) / c if c > 1 else 0.0)
-        nc = (2.0 * steps if (dx > 1 or dy > 1) else 0.0) + (1.0 if c > 1 else 0.0)
+        if c <= 1:
+            comm = ring(M / dx * K * item, dy) + ring(K * N / dy * item, dx)
+            nc = (1.0 if dy > 1 else 0.0) + (1.0 if dx > 1 else 0.0)
+        else:
+            steps = max(1, d // c)
+            comm = steps * (
+                allred(M / dx * K / d * item, dy)
+                + allred(K / d * N / dy * item, dx)
+            )
+            nc = steps * ((1.0 if dy > 1 else 0.0) + (1.0 if dx > 1 else 0.0))
+        comm += allred(M / dx * N / dy * item, c)
+        nc += 1.0 if c > 1 else 0.0
         return fl, comm, nc
 
     p = dx * dy * c
@@ -294,10 +306,19 @@ def _predict_py(n, dx, dy, c, peak, bw, alpha, item, bc, pol, split, complete_in
 
     def walk(w, top):
         if w <= bc:
+            # replicate + policy-scoped factorization (utils/config.py):
+            # policy 1 adds 2 result psums over depth, 2/3 over the mesh
             acc[0] += 2.0 * w**3 / 3.0
             if p > 1:
-                acc[1] += ring(w * w * item, p)
-                acc[2] += 2.0 if pol >= 2 else 1.0
+                panel = w * w * item
+                acc[1] += ring(panel, p)
+                acc[2] += 1.0
+                if pol == 1 and c > 1:
+                    acc[1] += 2.0 * allred(panel, c)
+                    acc[2] += 2.0
+                elif pol >= 2:
+                    acc[1] += 2.0 * allred(panel, p)
+                    acc[2] += 2.0
             return
         n1 = max(bc, w >> split)
         m2 = w - n1

@@ -164,19 +164,30 @@ static inline double allreduce_bytes(double bytes, int64_t p) {
 }
 
 // SUMMA gemm model (tracing.gemm_cost): C[M,N] += A[M,K]B[K,N].
+// Mirrors the explicit schedule's two distribution encodings
+// (parallel/summa.py:_explicit_matmul): c == 1 amortized ring all_gathers;
+// c > 1 per-step masked-psum broadcasts of the layer's d/c panels.
 static Cost gemm_cost(int64_t M, int64_t N, int64_t K, int64_t dx, int64_t dy,
                       int64_t c, int64_t item, double tri_frac) {
   const int64_t p = dx * dy * c;
   const int64_t d = std::max(dx, dy);
-  const int64_t steps = std::max<int64_t>(1, d / std::max<int64_t>(c, 1));
   Cost r;
   r.flops = tri_frac * 2.0 * (double)M * N * K / (double)p;
-  double a_blk = ((double)M / dx) * ((double)K / d) * item;
-  double b_blk = ((double)K / d) * ((double)N / dy) * item;
   double c_blk = ((double)M / dx) * ((double)N / dy) * item;
-  r.comm = steps * (ring_bytes(a_blk, dy) + ring_bytes(b_blk, dx)) +
-           allreduce_bytes(c_blk, c);
-  r.ncoll = ((dx > 1 || dy > 1) ? 2.0 * steps : 0.0) + (c > 1 ? 1.0 : 0.0);
+  if (c <= 1) {
+    double a_row = ((double)M / dx) * (double)K * item;
+    double b_col = (double)K * ((double)N / dy) * item;
+    r.comm = ring_bytes(a_row, dy) + ring_bytes(b_col, dx);
+    r.ncoll = (dy > 1 ? 1.0 : 0.0) + (dx > 1 ? 1.0 : 0.0);
+  } else {
+    const int64_t steps = std::max<int64_t>(1, d / c);
+    double a_pan = ((double)M / dx) * ((double)K / d) * item;
+    double b_pan = ((double)K / d) * ((double)N / dy) * item;
+    r.comm = steps * (allreduce_bytes(a_pan, dy) + allreduce_bytes(b_pan, dx));
+    r.ncoll = steps * ((dy > 1 ? 1.0 : 0.0) + (dx > 1 ? 1.0 : 0.0));
+  }
+  r.comm += allreduce_bytes(c_blk, c);
+  r.ncoll += c > 1 ? 1.0 : 0.0;
   return r;
 }
 
@@ -190,13 +201,23 @@ static void cholinv_walk(int64_t w, int64_t bc, int64_t split, int64_t dx,
                          int32_t complete_inv, Cost* acc) {
   const int64_t p = dx * dy * c;
   if (w <= bc) {
-    // base case: redundant potrf+trtri on the replicated panel (policies
-    // 0/1: allgather over the mesh; 2/3: gather+scatter — same bytes, one
-    // extra collective round)
+    // base case (models/cholesky.py:_base_case_into): the panel is
+    // replicated (allgather over the mesh); the policy then decides who
+    // factors it — policy 0 every device (no further collective), policy 1
+    // the z=0 layer + 2 result psums over depth, policies 2/3 the root
+    // device + 2 result psums over the whole mesh
     acc->flops += 2.0 * (double)w * w * w / 3.0;
     if (p > 1) {
-      acc->comm += ring_bytes((double)w * w * item, p);
-      acc->ncoll += (policy >= 2) ? 2.0 : 1.0;
+      double panel = (double)w * w * item;
+      acc->comm += ring_bytes(panel, p);
+      acc->ncoll += 1.0;
+      if (policy == 1 && c > 1) {
+        acc->comm += 2.0 * allreduce_bytes(panel, c);
+        acc->ncoll += 2.0;
+      } else if (policy >= 2) {
+        acc->comm += 2.0 * allreduce_bytes(panel, p);
+        acc->ncoll += 2.0;
+      }
     }
     return;
   }

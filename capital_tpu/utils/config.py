@@ -18,30 +18,26 @@ class BaseCasePolicy(enum.Enum):
     """Base-case execution strategies (reference cholinv/policy.h:160-514).
 
     The reference trades replicated computation against gather/scatter
-    communication on CPU clusters.  On a TPU mesh the trade collapses:
-    replicating a small panel (one all_gather over ICI) and computing it
-    redundantly on every chip is strictly cheaper than gathering to a root
-    chip and scattering back, because redundant small-matrix compute is free
-    relative to the extra collectives and the idle mesh (SURVEY §7.1).  All
-    four policies are accepted for config/sweep parity; they select the
-    gather scope used before the local potrf+trtri:
+    communication on CPU clusters.  On a TPU mesh, replicating a small
+    panel (one all_gather over ICI) and computing it redundantly on every
+    chip is usually cheapest (redundant small-matrix compute is free
+    relative to extra collectives — SURVEY §7.1), but all four strategies
+    are genuinely implemented so the trade is measurable, not asserted
+    (models/cholesky.py:_base_case_into / _scoped_base_factor):
 
-      REPLICATE_COMM_COMP   gather to every device, all compute (TPU default;
-                            reference policy.h:160-224 'ReplicateCommComp')
-      REPLICATE_COMP        reference computes on layer z=0 then bcasts
-                            (policy.h:226-305); on TPU identical collective
-                            traffic to the above with strictly less useful
-                            work per chip — implemented as the same schedule
-      NO_REPLICATION        reference gathers to the single root rank
-                            (policy.h:307-414); the TPU mapping places no
-                            explicit constraint on the panel and lets the
-                            SPMD partitioner choose placement (which may
-                            gather to fewer devices) — see
-                            models/cholesky.py:_base_case_into
-      NO_REPLICATION_OVERLAP reference overlaps the scatter with trtri
-                            (policy.h:416-514); XLA's latency-hiding
-                            scheduler owns overlap on TPU — same mapping as
-                            NO_REPLICATION
+      REPLICATE_COMM_COMP   gather to every device, every device factors the
+                            panel (TPU default; reference policy.h:160-224
+                            'ReplicateCommComp')
+      REPLICATE_COMP        only the z=0 depth layer factors; the result is
+                            broadcast down 'z' as a psum of the layer-masked
+                            value (reference policy.h:226-305)
+      NO_REPLICATION        only the root device (0,0,0) factors; the result
+                            is broadcast over the whole mesh (reference
+                            gather-to-root + scatter, policy.h:307-414)
+      NO_REPLICATION_OVERLAP same schedule as NO_REPLICATION; the reference
+                            overlaps the scatter with trtri by hand
+                            (policy.h:416-514) — on TPU, XLA's
+                            latency-hiding scheduler owns that overlap
     """
 
     REPLICATE_COMM_COMP = 0
@@ -50,8 +46,11 @@ class BaseCasePolicy(enum.Enum):
     NO_REPLICATION_OVERLAP = 3
 
     @property
-    def single_device_compute(self) -> bool:
-        return self in (
-            BaseCasePolicy.NO_REPLICATION,
-            BaseCasePolicy.NO_REPLICATION_OVERLAP,
-        )
+    def compute_scope(self) -> str:
+        """Which devices run the panel factorization: 'all' | 'layer' |
+        'root' (see class docstring)."""
+        if self is BaseCasePolicy.REPLICATE_COMM_COMP:
+            return "all"
+        if self is BaseCasePolicy.REPLICATE_COMP:
+            return "layer"
+        return "root"

@@ -292,3 +292,31 @@ class TestExplicitEmission:
             .as_text()
         )
         assert "conditional" in txt
+
+
+def test_chunked_bf16_accumulates_f32():
+    # ADVICE r1: chunking must not multiply low-precision partial-sum
+    # roundoff — local accumulation is f32 for sub-f32 inputs, so a heavily
+    # chunked schedule matches the unchunked one to bf16 resolution
+    from capital_tpu.parallel.topology import Grid
+
+    devs = jax.devices("cpu")[:4]
+    K = 256
+    A64 = np.asarray(rand48.random(32, K, key=31))
+    B64 = np.asarray(rand48.random(K, 32, key=32))
+    ref = A64 @ B64
+
+    def err(chunks):
+        g = Grid.rect(2, 2, 1, devices=devs, num_chunks=chunks)
+        C = summa.gemm(
+            g,
+            _put(g, jnp.asarray(A64, jnp.bfloat16)),
+            _put(g, jnp.asarray(B64, jnp.bfloat16)),
+            mode="explicit",
+        )
+        return float(np.abs(np.asarray(C, np.float64) - ref).max())
+
+    e1, e8 = err(1), err(8)
+    # identical f32 accumulators, one output rounding each: the chunked
+    # error may differ only by reassociation of the f32 partials
+    assert e8 <= e1 * 1.05 + 1e-6, (e1, e8)

@@ -320,3 +320,28 @@ def test_chunked_bf16_accumulates_f32():
     # identical f32 accumulators, one output rounding each: the chunked
     # error may differ only by reassociation of the f32 partials
     assert e8 <= e1 * 1.05 + 1e-6, (e1, e8)
+
+
+@pytest.mark.parametrize("chunks", [2, 4])
+def test_chunked_explicit_triangular(chunks):
+    # the per-(segment, chunk) liveness math under a chunked schedule, for
+    # both a triangular operand (trmm) and a triangular output (syrk), on
+    # the full 3D grid — the interplay the plain chunked-gemm test misses
+    from capital_tpu.parallel.topology import Grid
+
+    g = Grid.square(c=2, devices=jax.devices("cpu")[:8], num_chunks=chunks)
+    n = 16 * chunks
+    A = rand48.random(n, n, key=41)
+    B = rand48.random(n, 24, key=42)
+    got = summa.trmm(
+        g, _put(g, A), _put(g, B), TrmmArgs(side="L", uplo="U", trans_a=True),
+        mode="explicit",
+    )
+    np.testing.assert_allclose(np.asarray(got), np.triu(A).T @ B, rtol=1e-12)
+
+    C0 = rand48.symmetric(n)
+    got2 = summa.syrk(
+        g, _put(g, A), _put(g, C0), SyrkArgs(trans=True, alpha=-1.0, beta=1.0),
+        mode="explicit",
+    )
+    np.testing.assert_allclose(np.asarray(got2), -(A.T @ A) + C0, rtol=1e-12)

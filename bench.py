@@ -92,7 +92,8 @@ def main() -> None:
     # base case: 512 is the committed sweet spot; for n that 512 cannot
     # tile exactly (the aligned pallas path needs n = bc * 2^k), fall back
     # to the largest 128-multiple that does rather than padding — at
-    # n=49152 a 512 base would pad to 65536 (1.8x the flops and an OOM)
+    # n=49152 a 512 base would pad to 65536 ((4/3)^3 ≈ 2.4x the flops and
+    # an OOM)
     bc = int(sys.argv[4]) if len(sys.argv) > 4 else 0
     if not bc:
         # candidates must be 128-multiples: the pallas view path needs every

@@ -121,7 +121,13 @@ def rectri(
 
     from capital_tpu.models.cholesky import pad_embed_identity, padded_dim
 
-    p = padded_dim(n, cfg.base_case_dim)
+    # pad to the SMALLER of the bc-chain size (perfectly aligned windows)
+    # and plain 256-lane alignment: the recursion handles odd halving, so a
+    # forced bc * 2^k pad would cost up to (p/n)^3 ≈ 2.4x the flops for
+    # awkward n while buying nothing — misaligned deep-level windows merely
+    # take tri_matmul's materializing fallback.  Bench shapes (n = bc * 2^k)
+    # get the fully-aligned plan either way.
+    p = min(padded_dim(n, cfg.base_case_dim), -(-n // 256) * 256)
     # embed diag(T, I): stays lower-triangular, inverts to diag(T⁻¹, I)
     Tp = grid.pin(pad_embed_identity(T, n, p))
     out = grid.pin(jnp.zeros((p, p), dtype=T.dtype))

@@ -175,7 +175,10 @@ def _sweep_1d(
         tracing.emit(flops=tracing.potrf_trtri_flops(n))
         R, Rinv = lapack.potrf_trtri(G, uplo="U")
     with tracing.scope("CQR::formR"):
-        tri_kernel = g > 1 and grid.num_devices == 1
+        # the live-tile kernel is an explicit mode choice (the bench driver's
+        # 'auto' resolves to pallas on one TPU); other modes take the dense
+        # matmul — on CPU the interpreter would be orders of magnitude slower
+        tri_kernel = g > 1 and grid.num_devices == 1 and cfg.mode == "pallas"
         # live_frac applies only where the tri kernel actually skips dead
         # blocks; the multi-device path executes the dense matmul
         tracing.emit(

@@ -279,8 +279,11 @@ def _predict_py(n, dx, dy, c, peak, bw, alpha, item, bc, pol, split, complete_in
         return 2.0 * b * (p - 1) / p if p > 1 else 0.0
 
     def gemm(M, N, K, tri=0.5):
-        # mirrors tracing.gemm_cost: c==1 amortized ring all_gathers;
-        # c>1 per-step masked-psum broadcasts of the layer's d/c panels
+        # mirrors tracing.gemm_cost at num_chunks=1: c==1 amortized ring
+        # all_gathers; c>1 per-step masked-psum broadcasts of the layer's
+        # d/c panels.  The chunking knob is deliberately NOT modeled here
+        # (same bytes, q-scaled collective counts): the planner prefilters
+        # configs, and config spaces do not sweep chunks.
         p = dx * dy * c
         d = max(dx, dy)
         fl = tri * 2.0 * M * N * K / p

@@ -27,14 +27,23 @@ def test_gemm_cost_arithmetic(grid2x2x2):
     flops, comm, ncoll = tracing.gemm_cost(grid2x2x2, M, N, K, jnp.float32)
     # flops split evenly over 8 devices
     assert flops == pytest.approx(2 * M * N * K / 8)
-    # d=2, c=2: ring all_gather of the A block row over dy=2 and of the B
-    # block column over dx=2, plus the z allreduce of the C block — what
-    # _explicit_matmul emits (TestExplicitEmission checks against HLO)
-    a_row = (M / 2) * K * 4
-    b_col = K * (N / 2) * 4
-    expect = a_row * 0.5 + b_col * 0.5 + 2 * (M / 2) * (N / 2) * 4 * 0.5
+    # c=2 takes the masked-psum branch: d/c = 1 step, one psum-bcast pair of
+    # the (M/2, K/2) and (K/2, N/2) panels (2x ring bytes each), plus the z
+    # allreduce of the C block — what _explicit_matmul emits for c>1
+    # (TestExplicitEmission::test_psum_bcast_path_matches_model_c2)
+    a_pan = (M / 2) * (K / 2) * 4
+    b_pan = (K / 2) * (N / 2) * 4
+    c_blk = (M / 2) * (N / 2) * 4
+    expect = 2 * a_pan * 0.5 + 2 * b_pan * 0.5 + 2 * c_blk * 0.5
     assert comm == pytest.approx(expect)
     assert ncoll == 3
+    # and the c=1 branch prices the amortized gathers
+    g1 = Grid.square(c=1, devices=jax.devices("cpu")[:4])
+    _, comm1, ncoll1 = tracing.gemm_cost(g1, M, N, K, jnp.float32)
+    a_row = (M / 2) * K * 4
+    b_col = K * (N / 2) * 4
+    assert comm1 == pytest.approx(a_row * 0.5 + b_col * 0.5)
+    assert ncoll1 == 2
 
 
 def test_single_device_costs_no_comm():

@@ -77,6 +77,29 @@ def _enable_compile_cache() -> None:
         pass  # older jax without these flags: cold compile, still correct
 
 
+def auto_base_case(n: int) -> int:
+    """Base-case dim for the flagship: 512 is the committed sweet spot; for
+    n that 512 cannot tile exactly (the aligned pallas path needs
+    n = bc * 2^k), fall back to the largest 128-multiple that does rather
+    than padding — at n=49152 a 512 base would pad to 65536 ((4/3)^3 ≈ 2.4x
+    the flops and an HBM OOM).  Candidates must be 128-multiples (the
+    pallas view path needs every window offset 128-aligned,
+    ops/pallas_tpu._fit_block)."""
+    from capital_tpu.models import cholesky
+
+    for cand in (512, 384, 256):
+        if cholesky.padded_dim(n, cand) == n:
+            return cand
+    print(
+        f"# warning: no 128-multiple base tiles n={n} exactly; "
+        f"padding to {cholesky.padded_dim(n, 512)} "
+        f"({cholesky.padded_dim(n, 512)**3 / n**3:.2f}x the flops — "
+        "pick n = bc * 2^k to avoid this)",
+        file=sys.stderr,
+    )
+    return 512
+
+
 def main() -> None:
     _enable_compile_cache()
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 32768
@@ -89,28 +112,8 @@ def main() -> None:
     dev = jax.devices()[0]
     grid = Grid.square(c=1, devices=[dev])
 
-    # base case: 512 is the committed sweet spot; for n that 512 cannot
-    # tile exactly (the aligned pallas path needs n = bc * 2^k), fall back
-    # to the largest 128-multiple that does rather than padding — at
-    # n=49152 a 512 base would pad to 65536 ((4/3)^3 ≈ 2.4x the flops and
-    # an OOM)
-    bc = int(sys.argv[4]) if len(sys.argv) > 4 else 0
-    if not bc:
-        # candidates must be 128-multiples: the pallas view path needs every
-        # window offset 128-aligned (ops/pallas_tpu._fit_block)
-        for cand in (512, 384, 256):
-            if cholesky.padded_dim(n, cand) == n:
-                bc = cand
-                break
-        else:
-            bc = 512
-            print(
-                f"# warning: no 128-multiple base tiles n={n} exactly; "
-                f"padding to {cholesky.padded_dim(n, bc)} "
-                f"({cholesky.padded_dim(n, bc)**3 / n**3:.2f}x the flops — "
-                "pick n = bc * 2^k to avoid this)",
-                file=sys.stderr,
-            )
+    # argv bc of 0 (or absent) means auto-pick
+    bc = (int(sys.argv[4]) if len(sys.argv) > 4 else 0) or auto_base_case(n)
     # bf16 throughput config: trailing updates at the MXU's native precision
     # through the pallas dead-block-skipping kernels, base case in f32
     # (CholinvConfig default picks f32 for narrow inputs)

@@ -34,3 +34,28 @@ def test_driver(argv):
 
 def test_suite_scaled():
     _run(["suite", "--dtype", "float32", "--iters", "1", "--scale", "64", "--validate"])
+
+
+def test_flagship_auto_base_case(capsys):
+    # bench.py's base-case pick must keep the flagship n tiled exactly —
+    # a wrong pick silently pads (up to 2.4x flops) or misaligns every
+    # pallas view window
+    import importlib.util
+    import pathlib
+
+    path = pathlib.Path(__file__).resolve().parent.parent / "bench.py"
+    spec = importlib.util.spec_from_file_location("flagship_bench", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    from capital_tpu.models.cholesky import padded_dim
+
+    assert mod.auto_base_case(32768) == 512
+    assert mod.auto_base_case(49152) == 384
+    assert mod.auto_base_case(16384) == 512
+    assert mod.auto_base_case(24576) == 384
+    for n in (32768, 49152, 24576):
+        bc = mod.auto_base_case(n)
+        assert padded_dim(n, bc) == n and bc % 128 == 0
+    # untileable n: falls back to 512 and says so
+    assert mod.auto_base_case(40000) == 512
+    assert "padding to" in capsys.readouterr().err

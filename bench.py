@@ -84,25 +84,61 @@ def auto_base_case(n: int) -> int:
     than padding — at n=49152 a 512 base would pad to 65536 ((4/3)^3 ≈ 2.4x
     the flops and an HBM OOM).  Candidates must be 128-multiples (the
     pallas view path needs every window offset 128-aligned,
-    ops/pallas_tpu._fit_block)."""
+    ops/pallas_tpu._fit_block).  When nothing tiles exactly, pick the
+    candidate minimizing the padded dim (least wasted flops), not blindly
+    512 — and warn; main() also records the padded dim in the JSON line so
+    non-interactive consumers see the cost."""
     from capital_tpu.models import cholesky
 
     for cand in (512, 384, 256):
         if cholesky.padded_dim(n, cand) == n:
             return cand
+    best = min((512, 384, 256), key=lambda c: (cholesky.padded_dim(n, c), -c))
     print(
         f"# warning: no 128-multiple base tiles n={n} exactly; "
-        f"padding to {cholesky.padded_dim(n, 512)} "
-        f"({cholesky.padded_dim(n, 512)**3 / n**3:.2f}x the flops — "
+        f"padding to {cholesky.padded_dim(n, best)} with bc={best} "
+        f"({cholesky.padded_dim(n, best)**3 / n**3:.2f}x the flops — "
         "pick n = bc * 2^k to avoid this)",
         file=sys.stderr,
     )
-    return 512
+    return best
+
+
+def spd_hash(n: int, dtype, salt) -> "jnp.ndarray":
+    """Deterministic well-conditioned SPD matrix as ONE fused elementwise
+    program — no RNG bit buffers, no transpose pass, exactly one n x n
+    output allocation.  Used by the one-shot loop, which must re-materialize
+    a fresh operand EVERY iteration (salt = loop index, so XLA cannot hoist
+    it) while three factor-sized buffers are already resident.
+
+    Entries: symmetric splitmix32-style hash of (min(i,j), max(i,j), salt)
+    mapped to U[-1, 1]/sqrt(n), plus a 3I shift.  Spectral norm of the
+    random part ≈ 2·sqrt(n·Var) = 2/sqrt(3) ≈ 1.16, so the spectrum sits in
+    ~[1.8, 4.2]: safely SPD at bf16 like _spd's Wigner operand (same 3I
+    margin — see capital_tpu/bench/drivers.py:_spd on why not 2I)."""
+    from jax import lax
+
+    r = lax.broadcasted_iota(jnp.uint32, (n, n), 0)
+    c = lax.broadcasted_iota(jnp.uint32, (n, n), 1)
+    lo, hi = jnp.minimum(r, c), jnp.maximum(r, c)
+    h = lo * jnp.uint32(0x9E3779B1) ^ hi * jnp.uint32(0x85EBCA77)
+    h = h + jnp.asarray(salt).astype(jnp.uint32) * jnp.uint32(0xC2B2AE3D)
+    h = (h ^ (h >> 16)) * jnp.uint32(0x7FEB352D)
+    h = (h ^ (h >> 15)) * jnp.uint32(0x846CA68B)
+    h = h ^ (h >> 16)
+    u = h.astype(jnp.float32) * jnp.float32(2.0**-32)  # [0, 1)
+    v = (2.0 * u - 1.0) * jnp.float32(1.0 / float(n) ** 0.5)
+    v = v + jnp.where(r == c, jnp.float32(3.0), jnp.float32(0.0))
+    return v.astype(dtype)
 
 
 def main() -> None:
     _enable_compile_cache()
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else 32768
+    # default 49152, not 32768: the larger size amortizes the diagonal-band
+    # masking and base-case latency floors (169.3-169.9 TF/s = 0.955-0.958
+    # vs 156.8-157.1 = 0.886 at 32768, three runs each) and is the largest
+    # bc·2^k that fits one v5e in the one-shot 3-buffer protocol below
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 49152
     dtype = jnp.dtype(sys.argv[2]) if len(sys.argv) > 2 else jnp.bfloat16
     iters = int(sys.argv[3]) if len(sys.argv) > 3 else 5
 
@@ -114,94 +150,181 @@ def main() -> None:
 
     # argv bc of 0 (or absent) means auto-pick
     bc = (int(sys.argv[4]) if len(sys.argv) > 4 else 0) or auto_base_case(n)
-    # bf16 throughput config: trailing updates at the MXU's native precision
-    # through the pallas dead-block-skipping kernels, base case in f32
-    # (CholinvConfig default picks f32 for narrow inputs)
+    padded = cholesky.padded_dim(n, bc)
+
+    # One-shot mode for sizes whose 3-buffer resident set (operand carry +
+    # R + Rinv + the materialized Schur chain, ~3.35 n² at bf16) cannot fit
+    # one chip's HBM: the loop re-materializes a fresh operand per iteration
+    # (spd_hash of the loop index — one fused n² write) and factors it with
+    # schur_in_place, so peak memory is exactly 3 n² buffers (operand — dead
+    # after its last Schur read — plus the two factor buffers with every
+    # Schur update aliased in place).  n=49152 bf16: 14.5 GB vs 15.75;
+    # round-2's carry-mode attempt measured "Used 19.42G".  The regen cost
+    # is measured by a second loop with the factor removed and subtracted.
+    kind = dev.device_kind.lower()
+    if "lite" in kind or "v5e" in kind:
+        hbm = 15.5e9
+    elif "v5p" in kind:
+        hbm = 90e9
+    else:  # v4 / v6e: 32GB class; unknown chips get the conservative figure
+        hbm = 30e9
+    oneshot = 3.35 * padded * padded * jnp.dtype(dtype).itemsize > hbm
+    if os.environ.get("CAPITAL_BENCH_ONESHOT") in ("0", "1"):  # A/B override
+        oneshot = os.environ["CAPITAL_BENCH_ONESHOT"] == "1"
     cfg = cholesky.CholinvConfig(
         base_case_dim=bc,
         mode="pallas",
         precision=None if jnp.dtype(dtype).itemsize < 4 else "highest",
+        schur_in_place=oneshot,
     )
 
-    # well-conditioned SPD operand, generated on device (shared helper:
-    # 3I diagonal shift — the Wigner edge sits at exactly 2, so a 2I shift
-    # can graze a zero eigenvalue and NaN an f32/bf16 factorization
-    # depending on the RNG stream; an f32 host staging array would also be
-    # a 4.3GB transient at n=32768)
-    from capital_tpu.bench.drivers import _spd
-
-    A = _spd(n, dtype)
-
-    @jax.jit
-    def loop(a, eps, iters):
-        def body(_, carry):
-            R, Rinv = cholesky.factor(grid, carry, cfg)
-            # data-dependent carry consuming BOTH outputs: eps is a runtime
-            # scalar (0.0 at call time) so XLA cannot fold the perturbation
-            # away and dead-code-eliminate the factorization.  Consuming one
-            # element of each output is sufficient — R/Rinv are produced by
-            # chains of aliased pallas custom calls XLA cannot slice through,
-            # so every kernel still runs (verified on-device: elem-coupling
-            # 37.6 ms/iter vs 38.3 for full-sum consumption vs 18.0 when the
-            # Rinv chain is *actually* DCE'd, n=16k).  Consuming only R would
-            # kill the inverse-completion half of the work; a full-matrix
-            # carry add (carry + eps*(R+Rinv)) costs ~4 extra HBM passes of
-            # pure harness overhead (~10 ms/iter at n=32k).
-            d = R[0, 0] + Rinv[0, 0]
-            return carry.at[0, 0].add(eps.astype(carry.dtype) * d)
-
-        out = jax.lax.fori_loop(0, iters, body, a)
-        return jnp.sum(out, dtype=jnp.float32)
+    from capital_tpu.bench import harness
 
     eps = jnp.asarray(0.0, jnp.float32)
 
-    def timed(k: int) -> float:
-        t0 = time.perf_counter()
-        float(loop(A, eps, k))  # host transfer = real sync
-        return time.perf_counter() - t0
+    if oneshot:
+        @jax.jit
+        def loop(eps, iters):
+            def body(i, carry):
+                # optimization_barrier pins the generator as a materialized
+                # n² buffer in BOTH loops (without it the regen-only loop's
+                # one-element consumption would let XLA narrow the fused
+                # generator to a single element and the subtraction would
+                # over-credit the factor)
+                a = jax.lax.optimization_barrier(spd_hash(n, dtype, i))
+                R, Rinv = cholesky.factor(grid, a, cfg)
+                d = R[0, 0] + Rinv[0, 0]
+                return carry + eps * d.astype(jnp.float32)
 
-    from capital_tpu.bench import harness
+            return jax.lax.fori_loop(0, iters, body, jnp.float32(0.0))
+
+        @jax.jit
+        def loop_regen(eps, iters):
+            def body(i, carry):
+                a = jax.lax.optimization_barrier(spd_hash(n, dtype, i))
+                return carry + eps * a[0, 0].astype(jnp.float32)
+
+            return jax.lax.fori_loop(0, iters, body, jnp.float32(0.0))
+
+        def timed(k: int) -> float:
+            t0 = time.perf_counter()
+            float(loop(eps, k))
+            return time.perf_counter() - t0
+
+        def timed_regen(k: int) -> float:
+            t0 = time.perf_counter()
+            float(loop_regen(eps, k))
+            return time.perf_counter() - t0
+    else:
+        # standard mode: the operand is the loop carry (no per-iteration
+        # regeneration; ~3.35 n² resident is fine at these sizes)
+        from capital_tpu.bench.drivers import _spd
+
+        # well-conditioned SPD operand, generated on device (shared helper:
+        # 3I diagonal shift — the Wigner edge sits at exactly 2, so a 2I
+        # shift can graze a zero eigenvalue and NaN an f32/bf16 factorization
+        # depending on the RNG stream; an f32 host staging array would also
+        # be a 4.3GB transient at n=32768)
+        A = _spd(n, dtype)
+
+        @jax.jit
+        def loop(a, eps, iters):
+            def body(_, carry):
+                R, Rinv = cholesky.factor(grid, carry, cfg)
+                # data-dependent carry consuming BOTH outputs: eps is a
+                # runtime scalar (0.0 at call time) so XLA cannot fold the
+                # perturbation away and dead-code-eliminate the
+                # factorization.  Consuming one element of each output is
+                # sufficient — R/Rinv are produced by chains of aliased
+                # pallas custom calls XLA cannot slice through, so every
+                # kernel still runs (verified on-device: elem-coupling 37.6
+                # ms/iter vs 38.3 for full-sum consumption vs 18.0 when the
+                # Rinv chain is *actually* DCE'd, n=16k).  Consuming only R
+                # would kill the inverse-completion half of the work; a
+                # full-matrix carry add (carry + eps*(R+Rinv)) costs ~4
+                # extra HBM passes of pure harness overhead (~10 ms/iter at
+                # n=32k).
+                d = R[0, 0] + Rinv[0, 0]
+                return carry.at[0, 0].add(eps.astype(carry.dtype) * d)
+
+            out = jax.lax.fori_loop(0, iters, body, a)
+            return jnp.sum(out, dtype=jnp.float32)
+
+        def timed(k: int) -> float:
+            t0 = time.perf_counter()
+            float(loop(A, eps, k))  # host transfer = real sync
+            return time.perf_counter() - t0
+
+        timed_regen = None
 
     timed(1)  # warmup: compile (dynamic trip count -> one executable)
     timed(1)  # second warmup: let clocks/tunnel state settle post-compile
     # Interleaved (base, full) pairs + median — the one protocol shared with
     # harness.timed_loop; see paired_median_delta for the drift-bias story.
-    def run(k: int) -> float:
-        return timed(k)
-
-    t, delta = harness.paired_median_delta(run, iters, 8)
+    t, delta = harness.paired_median_delta(timed, iters, 8)
     noise = harness.noise_band_seconds()
     while iters < 512 and delta < noise:
         # small-n runs: grow the in-jit loop until the delta clears the band
         grow = int(3.0 * noise / t) if t > 0.0 else iters * 8
         iters = min(512, max(iters * 2, grow))
-        t, delta = harness.paired_median_delta(run, iters, 5)
+        t, delta = harness.paired_median_delta(timed, iters, 5)
     if t <= 0.0 or delta < noise:
         raise SystemExit(
             f"measurement unresolved: delta {delta:.3e}s at {iters} "
             "iterations is inside the dispatch-noise band"
         )
 
+    t_regen = 0.0
+    if oneshot:
+        timed_regen(1)  # compile the regen-only loop
+        # the regen step (~one fused n² write) is far below the factor but
+        # must clear the noise band on its own; grow its trip count
+        # independently (cheap — no factor inside)
+        kr = max(iters, 16)
+        t_regen, dr = harness.paired_median_delta(timed_regen, kr, 8)
+        while kr < 4096 and dr < noise:
+            kr = min(4096, max(kr * 2, int(3.0 * noise / max(t_regen, 1e-9))))
+            t_regen, dr = harness.paired_median_delta(timed_regen, kr, 5)
+        if t_regen < 0.0 or dr < noise:
+            raise SystemExit(
+                f"regen measurement unresolved: delta {dr:.3e}s at {kr} "
+                "iterations is inside the dispatch-noise band"
+            )
+        t = t - t_regen
+        # the SUBTRACTED time is the reported quantity: it must itself be
+        # positive and clear the band over the measured trip count, else
+        # the factor is measurement noise riding on two valid loops (small
+        # n under the A/B override: two medians can jitter past each other
+        # and print a negative or infinite TF/s)
+        if t <= 0.0 or t * iters < noise:
+            raise SystemExit(
+                f"oneshot measurement unresolved: factor-only time "
+                f"{t:.3e}s/iter after regen subtraction is inside the "
+                "dispatch-noise band"
+            )
+
     flops = 2.0 * n**3 / 3.0  # factor (n^3/3) + full triangular inverse (n^3/3)
     tflops = flops / t / 1e12
     target = 0.9 * _peak_tflops(dev.device_kind, dtype)
 
-    print(
-        json.dumps(
-            {
-                "metric": "cholinv_tflops",
-                "value": round(tflops, 3),
-                "unit": "TFLOP/s",
-                "vs_baseline": round(tflops / target, 4),
-                "n": n,
-                "bc": bc,
-                "dtype": str(jnp.dtype(dtype)),
-                "seconds": round(t, 4),
-                "device": dev.device_kind,
-                "target_tflops": round(target, 1),
-            }
-        )
-    )
+    rec = {
+        "metric": "cholinv_tflops",
+        "value": round(tflops, 3),
+        "unit": "TFLOP/s",
+        "vs_baseline": round(tflops / target, 4),
+        "n": n,
+        "bc": bc,
+        "dtype": str(jnp.dtype(dtype)),
+        "seconds": round(t, 4),
+        "device": dev.device_kind,
+        "target_tflops": round(target, 1),
+    }
+    if padded != n:
+        rec["padded"] = padded  # flops above count n³, not the executed padded³
+    if oneshot:
+        rec["oneshot"] = True
+        rec["regen_seconds"] = round(t_regen, 4)
+    print(json.dumps(rec))
 
 
 if __name__ == "__main__":

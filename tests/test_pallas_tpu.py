@@ -158,6 +158,78 @@ def test_tri_matmul_fused_beta_views():
     _close(jnp.triu(got2), wantm)
 
 
+def test_tri_matmul_inplace_rmw_syrk():
+    """In-place tri-output RMW: out IS the C buffer — live tiles are read,
+    updated, and written back at the same offsets; every untouched region of
+    the buffer (outside the window, and the window's dead half on the
+    aligned path) is preserved.  This is the no-Schur-chain memory mode of
+    cholinv (schur_in_place)."""
+    rng = np.random.default_rng(7)
+    buf = jnp.asarray(rng.standard_normal((512, 512)))
+    Rp = jnp.asarray(rng.standard_normal((512, 512)))
+    got = tri_matmul(
+        Rp, Rp, a_trans=True, b_trans=False, out_uplo="U", alpha=-1.0,
+        a_view=(128, 256, 128, 256), b_view=(128, 256, 128, 256),
+        c=buf, c_view=(256, 256, 256, 256), beta=1.0,
+        out=buf, out_off=(256, 256),
+        blocks=(128, 128, 128),  # multi-tile: 2x2 output window, 3 live tiles
+    )
+    assert got.shape == buf.shape
+    R12 = Rp[128:256, 256:512]
+    want = jnp.triu(-(R12.T @ R12) + buf[256:512, 256:512])
+    _close(jnp.triu(got[256:512, 256:512]), want)
+    # untouched regions of the buffer survive the aliased write
+    _close(got[:256, :], buf[:256, :])
+    _close(got[256:, :256], buf[256:, :256])
+    # aligned kernel path: the window's dead (strictly-lower) tiles are
+    # never visited, so they keep the ORIGINAL buffer contents — here the
+    # (1, 0) tile of the 2x2 window
+    _close(got[384:512, 256:384], buf[384:512, 256:384])
+
+    # shifted-window / non-C out combinations are rejected, not mis-written
+    with pytest.raises(ValueError, match="out to BE the C operand"):
+        tri_matmul(
+            Rp, Rp, a_trans=True, out_uplo="U",
+            a_view=(128, 256, 128, 256), b_view=(128, 256, 128, 256),
+            c=buf, c_view=(256, 256, 256, 256), beta=1.0,
+            out=buf, out_off=(0, 0),
+        )
+
+    # misaligned windows: the materializing fallback writes the full window
+    # (dead half = beta*C, the documented fallback behavior) but preserves
+    # everything outside it
+    got2 = tri_matmul(
+        Rp, Rp, a_trans=True, b_trans=False, out_uplo="U", alpha=-1.0,
+        a_view=(100, 200, 100, 200), b_view=(100, 200, 100, 200),
+        c=buf, c_view=(200, 200, 200, 200), beta=1.0,
+        out=buf, out_off=(200, 200),
+    )
+    R12m = Rp[100:200, 200:400]
+    wantm = jnp.triu(-(R12m.T @ R12m) + buf[200:400, 200:400])
+    _close(jnp.triu(got2[200:400, 200:400]), wantm)
+    _close(got2[:200, :], buf[:200, :])
+
+
+def test_summa_syrk_in_place_modes(grid1):
+    """summa.syrk(in_place=True) agrees with the out-of-place result across
+    pallas and xla modes (window write-back semantics only differ in where
+    the result lands)."""
+    rng = np.random.default_rng(8)
+    buf = jnp.asarray(rng.standard_normal((256, 256)))
+    A = jnp.asarray(rng.standard_normal((256, 256)))
+    args = summa.SyrkArgs(trans=True, alpha=-1.0, beta=1.0)
+    for mode in ("pallas", "xla"):
+        got = summa.syrk(
+            grid1, A, buf, args, mode=mode,
+            a_view=(0, 128, 128, 128), c_view=(128, 128, 128, 128),
+            in_place=True,
+        )
+        R12 = A[0:128, 128:256]
+        want = jnp.triu(-(R12.T @ R12) + buf[128:256, 128:256])
+        _close(jnp.triu(got[128:, 128:]), want, tol=1e-9)
+        _close(got[:128, :], buf[:128, :])
+
+
 def test_tri_matmul_fused_beta_promotes_c_dtype():
     """Mixed dtypes: a wider C promotes the result exactly like the unfused
     `AB + beta*C` (mode='xla') would — on the aligned kernel path and the
@@ -198,6 +270,24 @@ def test_cholinv_pallas_mode_end_to_end(grid1):
     R, Rinv = jax.jit(lambda a: cholesky.factor(grid1, a, cfg))(A)
     assert float(residual.cholesky_residual(A, R)) < 1e-13
     assert float(residual.cholesky_inverse_residual(R, Rinv)) < 1e-13
+
+
+def test_cholinv_schur_in_place_matches_default(grid1):
+    """schur_in_place=True (the no-Schur-chain memory mode that fits n=49152
+    on one v5e) must produce the same factor/inverse as the default — on the
+    aligned pallas path (views + aliased RMW end to end) and on xla mode,
+    and on a misaligned size that exercises the fallbacks."""
+    for n, bc, mode in ((512, 128, "pallas"), (512, 128, "xla"), (192, 64, "pallas")):
+        A = jnp.asarray(rand48.symmetric(n))
+        base = cholesky.CholinvConfig(base_case_dim=bc, mode=mode)
+        inpl = cholesky.CholinvConfig(
+            base_case_dim=bc, mode=mode, schur_in_place=True
+        )
+        R0, RI0 = jax.jit(lambda a: cholesky.factor(grid1, a, base))(A)
+        R1, RI1 = jax.jit(lambda a: cholesky.factor(grid1, a, inpl))(A)
+        np.testing.assert_array_equal(np.asarray(R0), np.asarray(R1))
+        np.testing.assert_array_equal(np.asarray(RI0), np.asarray(RI1))
+        assert float(residual.cholesky_residual(A, R1)) < 1e-13
 
 
 def test_cholinv_pallas_mode_aligned_views(grid1):

@@ -162,12 +162,19 @@ def main() -> None:
     # round-2's carry-mode attempt measured "Used 19.42G".  The regen cost
     # is measured by a second loop with the factor removed and subtracted.
     kind = dev.device_kind.lower()
-    if "lite" in kind or "v5e" in kind:
-        hbm = 15.5e9
+    if "v6" in kind:  # v6e is "TPU v6 lite": match before the v5e 'lite' test
+        hbm = 30e9
     elif "v5p" in kind:
         hbm = 90e9
-    else:  # v4 / v6e: 32GB class; unknown chips get the conservative figure
+    elif "v4" in kind:
         hbm = 30e9
+    elif "lite" in kind or "v5e" in kind:
+        hbm = 15.5e9
+    else:
+        # unknown chips: assume SMALL — wrongly enabling one-shot only
+        # changes the protocol (still correct); wrongly assuming big HBM
+        # reproduces the round-2 compile-time OOM
+        hbm = 15.5e9
     oneshot = 3.35 * padded * padded * jnp.dtype(dtype).itemsize > hbm
     if os.environ.get("CAPITAL_BENCH_ONESHOT") in ("0", "1"):  # A/B override
         oneshot = os.environ["CAPITAL_BENCH_ONESHOT"] == "1"

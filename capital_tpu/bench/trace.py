@@ -1,0 +1,307 @@
+"""Per-phase device-time budgets from real hardware traces.
+
+The reference ships critter's symbol decomposition (autotune/util.h:63-127:
+per-phase cp-comp/cp-comm columns); the runtime counterpart here is a
+`jax.profiler` device trace of the actual benchmark loop, bucketed by the
+``CI::*`` / ``CQR::*`` phase scopes that `tracing.scope` stamps into every
+HLO op's metadata.  Wall clocks through the axon tunnel drift 2-3x on a
+minutes timescale — per-kernel device *own time* from the trace is the one
+measurement immune to that (docs/PERF.md "Measurement discipline"), so this
+is the tool that settles where a flagship millisecond actually goes.
+
+CLI::
+
+    python -m capital_tpu.bench.trace cholinv --n 16384 [--bc 512] [--iters 3]
+    python -m capital_tpu.bench.trace cacqr --m 1048576 --n 1024
+
+prints one line per phase bucket (device ms per iteration, % of total) plus
+a JSON record, from a trace of `iters` in-jit iterations of the same loop
+the flagship bench runs.
+
+Parsing: the xplane protobuf's "XLA Ops" line carries one event per HLO op
+execution with its self (own) duration; each op's metadata carries the
+named_scope chain (``CI.trsm`` etc.), searched longest-scope-first so
+nested scopes attribute to the innermost phase, matching critter's
+innermost-symbol attribution.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import json
+import os
+import tempfile
+
+import jax
+import jax.numpy as jnp
+
+
+#: flagship phase buckets, innermost-first.  An op whose metadata mentions
+#: none of these lands in 'copy' / 'fusion' / 'other' by HLO kind — the
+#: catch-alls that caught the round-2 relayout-copy regressions.
+PHASE_TAGS = (
+    "CI.factor_diag", "CI.trsm", "CI.tmu", "CI.inv",
+    "CQR.gram", "CQR.chol", "CQR.scale", "CQR.merge",
+    "RT.base", "RT.merge",
+)
+
+
+def _own_times(line):
+    """(metadata_id, own_duration_ps) per event: the 'XLA Ops' line is
+    hierarchical (a `while` event spans its whole body), so an op's own time
+    is its duration minus the durations of the events it directly contains —
+    a stack sweep over (offset, duration)-sorted events."""
+    evs = sorted(line.events, key=lambda e: (e.offset_ps, -e.duration_ps))
+    out = []
+    stack = []  # [end_ps, metadata_id, duration_ps, child_sum]
+    for e in evs:
+        start, dur = e.offset_ps, e.duration_ps
+        while stack and stack[-1][0] <= start:
+            fin = stack.pop()
+            own = fin[2] - fin[3]
+            if stack:
+                stack[-1][3] += fin[2]
+            out.append((fin[1], own))
+        if stack and start + dur > stack[-1][0]:
+            # overlapping, not nested (async tails) — treat as sibling
+            fin = stack.pop()
+            own = fin[2] - fin[3]
+            if stack:
+                stack[-1][3] += fin[2]
+            out.append((fin[1], own))
+        stack.append([start + dur, e.metadata_id, dur, 0])
+    while stack:
+        fin = stack.pop()
+        own = fin[2] - fin[3]
+        if stack:
+            stack[-1][3] += fin[2]
+        out.append((fin[1], own))
+    return out
+
+
+def _iter_xla_op_events(space):
+    """Yield (metadata, own_duration_ps, stat_metadata, is_async) for every
+    device XLA-op event.  The 'Async XLA Ops' line reports in-flight
+    occupancy of DMAs that overlap compute — kept separate (occupancy is
+    not additive with op own time)."""
+    for plane in space.planes:
+        if "TPU" not in plane.name:
+            continue
+        for line in plane.lines:
+            if line.name == "XLA Ops":
+                for mid, own_ps in _own_times(line):
+                    yield plane.event_metadata.get(mid), own_ps, plane.stat_metadata, False
+            elif line.name == "Async XLA Ops":
+                for ev in line.events:
+                    md = plane.event_metadata.get(ev.metadata_id)
+                    yield md, ev.duration_ps, plane.stat_metadata, True
+
+
+def _bucket(md, stat_metadata) -> str:
+    """Phase bucket for one op.  The HLO op NAME (XLA names each op after
+    the named_scope that produced it: %CI.tmu.90) is authoritative; the
+    metadata stats (tf_op paths etc.) often mention *several* scopes for
+    fused/derived ops and are only consulted when the name says nothing —
+    matching against them first mis-filed tmu kernels under trsm."""
+
+    def match(hay: str) -> str | None:
+        best = None
+        for tag in PHASE_TAGS:
+            if tag in hay and (best is None or len(tag) > len(best)):
+                best = tag
+        return best
+
+    name = md.name or md.display_name
+    best = match(name.split(" = ")[0])  # the op's own %name only
+    if best is None:
+        hay = name + " " + md.display_name
+        for s in md.stats:
+            sm = stat_metadata.get(s.metadata_id)
+            if sm is not None and sm.name in ("tf_op", "hlo_op", "name_scope"):
+                hay += " " + s.str_value
+        best = match(hay)
+    if best is not None:
+        return best.replace(".", "::")
+    if "copy" in name:
+        return "copy"
+    if "fusion" in name:
+        return "fusion"
+    if "custom-call" in name or "cholesky" in name or "triangular" in name:
+        return "custom-call"
+    return "other"
+
+
+def device_budget(run, trace_dir: str | None = None) -> dict[str, float]:
+    """Trace `run()` (which must block on completion) and return
+    {bucket: device milliseconds} of XLA-op own time, plus an
+    'async (overlapped)' entry for DMA in-flight occupancy (informational —
+    overlaps compute, not additive)."""
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    own = collections.defaultdict(float)
+    with tempfile.TemporaryDirectory() as tmp:
+        d = trace_dir or tmp
+        with jax.profiler.trace(d):
+            run()
+        paths = glob.glob(os.path.join(d, "**", "*.xplane.pb"), recursive=True)
+        if not paths:
+            raise RuntimeError(f"no xplane.pb under {d}")
+        for p in paths:
+            space = xplane_pb2.XSpace()
+            with open(p, "rb") as f:
+                space.ParseFromString(f.read())
+            for md, dur_ps, stat_md, is_async in _iter_xla_op_events(space):
+                if md is None:
+                    continue
+                key = "async (overlapped)" if is_async else _bucket(md, stat_md)
+                own[key] += dur_ps * 1e-9  # ps -> ms
+    return dict(own)
+
+
+def print_budget(budget: dict[str, float], iters: int, label: str) -> dict:
+    budget = dict(budget)
+    async_ms = budget.pop("async (overlapped)", 0.0)
+    total = sum(budget.values())
+    rows = sorted(budget.items(), key=lambda kv: -kv[1])
+    print(f"# device-op budget: {label} ({iters} traced iterations)")
+    for k, ms in rows:
+        print(f"#   {k:16s} {ms / iters:9.3f} ms/iter  {100 * ms / total:5.1f}%")
+    print(f"#   {'TOTAL':16s} {total / iters:9.3f} ms/iter")
+    if async_ms:
+        print(
+            f"#   {'async-overlap':16s} {async_ms / iters:9.3f} ms/iter  "
+            "(DMA occupancy, overlaps the rows above)"
+        )
+        rows = rows + [("async (overlapped)", async_ms)]
+    rec = {
+        "metric": "device_budget",
+        "label": label,
+        "iters": iters,
+        "total_ms_per_iter": round(total / iters, 3),
+        "phases_ms_per_iter": {k: round(v / iters, 3) for k, v in rows},
+    }
+    print(json.dumps(rec))
+    return rec
+
+
+def _cholinv_run(n: int, dtype, bc: int, iters: int, oneshot: bool):
+    """The flagship loop (bench.py's shape: fori_loop + element coupling),
+    compiled once and traced for `iters` iterations."""
+    from capital_tpu.models import cholesky
+    from capital_tpu.parallel.topology import Grid
+
+    grid = Grid.square(c=1, devices=[jax.devices()[0]])
+    cfg = cholesky.CholinvConfig(
+        base_case_dim=bc, mode="pallas",
+        precision=None if jnp.dtype(dtype).itemsize < 4 else "highest",
+        schur_in_place=oneshot,
+    )
+    eps = jnp.asarray(0.0, jnp.float32)
+
+    if oneshot:
+        import importlib.util
+        import pathlib
+
+        bench_path = pathlib.Path(__file__).resolve().parents[2] / "bench.py"
+        spec = importlib.util.spec_from_file_location("flagship_bench", bench_path)
+        bench = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(bench)
+
+        @jax.jit
+        def loop(eps, k):
+            def body(i, carry):
+                a = jax.lax.optimization_barrier(bench.spd_hash(n, dtype, i))
+                R, Rinv = cholesky.factor(grid, a, cfg)
+                return carry + eps * (R[0, 0] + Rinv[0, 0]).astype(jnp.float32)
+
+            return jax.lax.fori_loop(0, k, body, jnp.float32(0.0))
+
+        def run():
+            float(loop(eps, iters))
+    else:
+        from capital_tpu.bench.drivers import _spd
+
+        A = _spd(n, dtype)
+
+        @jax.jit
+        def loop(a, eps, k):
+            def body(_, carry):
+                R, Rinv = cholesky.factor(grid, carry, cfg)
+                d = R[0, 0] + Rinv[0, 0]
+                return carry.at[0, 0].add(eps.astype(carry.dtype) * d)
+
+            return jnp.sum(jax.lax.fori_loop(0, k, body, a), dtype=jnp.float32)
+
+        def run():
+            float(loop(A, eps, iters))
+
+    run()  # compile + warm
+    return run
+
+
+def _cacqr_run(m: int, n: int, dtype, bc: int, iters: int):
+    from capital_tpu.models import cholesky, qr
+    from capital_tpu.parallel.topology import Grid
+
+    grid = Grid.square(c=1, devices=[jax.devices()[0]])
+    precision = None if jnp.dtype(dtype).itemsize < 4 else "highest"
+    cfg = qr.CacqrConfig(
+        num_iter=2, mode="pallas",
+        cholinv=cholesky.CholinvConfig(
+            base_case_dim=bc, mode="pallas", precision=precision
+        ),
+        precision=precision,
+    )
+    A = jax.block_until_ready(
+        jax.random.normal(jax.random.key(0), (m, n), dtype=dtype)
+    )
+    eps = jnp.asarray(0.0, jnp.float32)
+
+    @jax.jit
+    def loop(a, eps, k):
+        def body(_, carry):
+            Q, R = qr.factor(grid, carry, cfg)
+            return Q.at[: R.shape[0], : R.shape[1]].add(R.astype(Q.dtype))
+
+        return jnp.sum(jax.lax.fori_loop(0, k, body, a), dtype=jnp.float32)
+
+    def run():
+        float(loop(A, eps, iters))
+
+    run()
+    return run
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(prog="capital_tpu.bench.trace")
+    p.add_argument("algo", choices=["cholinv", "cacqr"])
+    p.add_argument("--n", type=int, default=16384)
+    p.add_argument("--m", type=int, default=1 << 20)
+    p.add_argument("--bc", type=int, default=512)
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--iters", type=int, default=3)
+    p.add_argument("--oneshot", action="store_true",
+                   help="cholinv: trace the one-shot regen loop (the large-n "
+                        "flagship protocol) instead of the carry loop")
+    p.add_argument("--trace-dir", default=None,
+                   help="keep the raw trace here instead of a temp dir")
+    args = p.parse_args(argv)
+    dtype = jnp.dtype(args.dtype)
+
+    if args.algo == "cholinv":
+        run = _cholinv_run(args.n, dtype, args.bc, args.iters, args.oneshot)
+        label = f"cholinv n={args.n} bc={args.bc} {dtype}" + (
+            " oneshot" if args.oneshot else ""
+        )
+    else:
+        run = _cacqr_run(args.m, args.n, dtype, args.bc, args.iters)
+        label = f"cacqr {args.m}x{args.n} {dtype}"
+
+    budget = device_budget(run, args.trace_dir)
+    print_budget(budget, args.iters, label)
+
+
+if __name__ == "__main__":
+    main()

@@ -146,11 +146,21 @@ def _sweep_1d(
             collectives=ncoll * (g if g > 1 else 1),
         )
         if g > 1:
+            # each block-row partial is pinned replicated BEFORE the
+            # transpose/concat assembly: the cost model above prices g
+            # reductions of live_frac·n² bytes total, and without the
+            # constraint GSPMD is free to sink the psum past the assembly
+            # and move the dense n² in one collective (ADVICE r2) — the
+            # constraint makes the modeled schedule the emitted one
+            # (pinned by TestGramEmission1d)
             grows = [
-                jnp.matmul(
-                    A[:, i * nb : (i + 1) * nb].T,
-                    A[:, i * nb :],
-                    precision=precision,
+                lax.with_sharding_constraint(
+                    jnp.matmul(
+                        A[:, i * nb : (i + 1) * nb].T,
+                        A[:, i * nb :],
+                        precision=precision,
+                    ),
+                    grid.replicated_sharding(),
                 )
                 for i in range(g)
             ]

@@ -515,7 +515,10 @@ def tri_matmul(
         `out` value as consumed).  `out` may be the same buffer as A or B
         (e.g. writing one window of a triangular factor while reading
         another) provided the read and write windows are disjoint.
-        Incompatible with out_uplo.
+        With out_uplo, the ONE supported in-place form is the syrk
+        read-modify-write: out IS the C operand and out_off == the c_view
+        origin — each live tile is read (beta term) and rewritten in place
+        (cholinv's schur_in_place memory mode); anything else raises.
 
     Views require every window size/offset to be divisible by a viable block
     size (>= 128); otherwise the call transparently falls back to

@@ -159,6 +159,34 @@ class TestSweep1DBlocked:
             np.triu(np.asarray(Rp)), np.triu(np.asarray(Rx)), atol=1e-5
         )
 
+    def test_gram_emission_reduces_before_assembly(self, grid_flat8):
+        """ADVICE r2: the blocked-gram comm model prices g collectives of
+        live_frac·n² bytes total, which requires each block-row partial to
+        be reduced BEFORE the transpose/concat assembly.  Pin the emitted
+        HLO: per-block all-reduce result shapes appear (merged tuples
+        allowed) and the dense n x n never rides a single collective."""
+        import re
+
+        g = grid_flat8
+        n, nb = 512, 256  # g=2 blocking
+        A = jax.device_put(_tall(1024, n), g.rows_sharding())
+        txt = (
+            jax.jit(lambda a: qr._sweep_1d(g, a, CacqrConfig(regime="1d")))
+            .lower(A)
+            .compile()
+            .as_text()
+        )
+        ar_lines = [l for l in txt.splitlines() if re.search(r"= .*all-reduce\(", l)]
+        shapes = []
+        for l in ar_lines:
+            shapes += re.findall(r"f64\[(\d+),(\d+)\]", l.split(" = ")[1].split("all-reduce")[0])
+        shapes = [tuple(map(int, s)) for s in shapes]
+        # the two block-row partials: (256, 512) and (256, 256)
+        assert (nb, n) in shapes, (shapes, ar_lines)
+        assert (nb, nb) in shapes, (shapes, ar_lines)
+        # no collective carries the assembled dense gram
+        assert (n, n) not in shapes, (shapes, ar_lines)
+
     def test_pallas_mode_multidevice_falls_back(self, grid_flat8):
         # mode='pallas' on a mesh must silently use the distributed path
         g = grid_flat8

@@ -35,6 +35,13 @@ def main(argv=None) -> None:
         help="checkpoint per-config results under --out and skip configs a "
         "previous (preempted) sweep of the same problem already measured",
     )
+    p.add_argument(
+        "--grids", nargs="+", default=None,
+        help="grid-shape axis (the reference rep-factor loop, "
+        "bench/qr/cacqr.cpp:8-25): 'auto' enumerates feasible d x d x c "
+        "shapes over the devices (+ flat for cacqr), or explicit "
+        "DXxDYxC tokens like 2x2x1 2x2x2 flat",
+    )
     p.add_argument("--devices", type=int, default=0)
     p.add_argument("--platform", default=None)
     p.add_argument("--host-devices", type=int, default=0)
@@ -63,6 +70,20 @@ def main(argv=None) -> None:
         dev = dev[: args.devices]
     dtype = jnp.dtype(args.dtype)
     space = {"bc_dims": tuple(args.bc)} if args.bc else {}
+    if args.grids:
+        if args.grids == ["auto"]:
+            space["grids"] = sweep.grid_space(
+                dev, include_flat=(args.alg == "cacqr")
+            )
+        else:
+            gs = []
+            for tok in args.grids:
+                if tok == "flat":
+                    gs.append(Grid.flat(devices=dev))
+                    continue
+                dx, dy, c = (int(x) for x in tok.split("x"))
+                gs.append(Grid.rect(dx, dy, c, devices=dev[: dx * dy * c]))
+            space["grids"] = gs
     if args.alg == "cholinv":
         # these knobs exist only in the cholinv space (cacqr sweeps
         # variant x bc x regime)
@@ -74,7 +95,13 @@ def main(argv=None) -> None:
             from capital_tpu.utils.config import BaseCasePolicy
 
             space["policies"] = tuple(BaseCasePolicy[p] for p in args.policies)
-        grid = Grid.square(c=1, devices=dev)
+        # with a grid axis the base grid is just a placeholder (every config
+        # carries its own); devices counts like 8 have no square c=1 face
+        grid = (
+            space["grids"][0]
+            if "grids" in space
+            else Grid.square(c=1, devices=dev)
+        )
         res = sweep.tune_cholinv(
             grid, args.n, dtype, args.out, prefilter_top_k=args.top_k,
             checkpoint=args.resume, **space,

@@ -246,6 +246,51 @@ def _spd(n: int, dtype) -> jnp.ndarray:
     return _drivers_spd(n, dtype)
 
 
+def grid_space(
+    devices=None,
+    c_values: Iterable[int] = (1, 2, 4),
+    include_flat: bool = False,
+) -> list[Grid]:
+    """Feasible grid shapes over the available devices — the reference's
+    rep-factor loop (bench/qr/cacqr.cpp:8-25, qr tune.cpp sweeps grid shape
+    alongside bc).  For each replication depth c, the largest d x d x c
+    square grid the device count supports; plus the flat 1D topology when
+    requested (the tall-skinny regime).  Degenerates to [1x1x1] on one
+    device."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    grids: list[Grid] = []
+    seen: set[tuple[int, int, int]] = set()
+    for c in c_values:
+        d = 1
+        while (d + 1) * (d + 1) * c <= n:
+            d += 1
+        # feasibility: the explicit schedule needs c | d (summa.py K-segment
+        # split), so a 2x2x4 "fits 16 devices" shape would abort a sweep
+        # mid-run; and 1x1xC is pure redundancy, not a topology
+        if (
+            d * d * c <= n
+            and (d, d, c) not in seen
+            and (d > 1 or c == 1)
+            and d % c == 0
+        ):
+            seen.add((d, d, c))
+            grids.append(Grid.square(c=c, devices=devices[: d * d * c]))
+    if include_flat and n > 1:
+        grids.append(Grid.flat(devices=devices))
+    return grids
+
+
+def _with_grids(grids, base_grid):
+    """The grid axis of a config space: explicit list, or just the fixed
+    sweep grid."""
+    return list(grids) if grids else [base_grid]
+
+
+def _gid(grid: Grid) -> str:
+    return f"g{grid.dx}x{grid.dy}x{grid.c}"
+
+
 def cholinv_space(
     grid: Grid,
     dtype,
@@ -256,23 +301,35 @@ def cholinv_space(
     ),
     splits: Iterable[int] = (1,),
     modes: Iterable[str] = ("xla",),
+    grids: Iterable[Grid] | None = None,
 ):
-    """policy x bc x split x mode — the reference's decomposition sweep
-    (cholesky tune.cpp:175-253: 3 policies x bcMultiplier range)."""
+    """policy x bc x split x mode (x grid shape) — the reference's
+    decomposition sweep (cholesky tune.cpp:175-253: 3 policies x
+    bcMultiplier range) plus the rep-factor/grid-shape axis (`grids`,
+    e.g. from grid_space()).  The operand reshards to each grid's face on
+    the first in-loop iteration; subsequent iterations carry the face
+    layout, so the measured steady-state time is that grid's."""
     prec = None if jnp.dtype(dtype).itemsize < 4 else "highest"
-    for pol, bc, split, mode in itertools.product(policies, bc_dims, splits, modes):
+    glist = _with_grids(grids, grid)
+    for g, pol, bc, split, mode in itertools.product(
+        glist, policies, bc_dims, splits, modes
+    ):
         cfg = cholesky.CholinvConfig(
             base_case_dim=bc, split=split, policy=pol, mode=mode, precision=prec
         )
 
-        def step(a, cfg=cfg):
-            R, Rinv = cholesky.factor(grid, a, cfg)
+        def step(a, cfg=cfg, g=g):
+            R, Rinv = cholesky.factor(g, a, cfg)
             return R + Rinv
 
         cid = f"pol{pol.value}_bc{bc}_s{split}_{mode}"
-        yield cid, {
+        cdict = {
             "policy": pol.name, "base_case_dim": bc, "split": split, "mode": mode,
-        }, step
+        }
+        if len(glist) > 1:
+            cid = f"{_gid(g)}_{cid}"
+            cdict["grid"] = repr(g)
+        yield cid, cdict, step
 
 
 def cacqr_space(
@@ -281,11 +338,16 @@ def cacqr_space(
     bc_dims: Iterable[int] = (128, 256, 512),
     variants: Iterable[int] = (1, 2),
     regimes: Iterable[str] = ("auto",),
+    grids: Iterable[Grid] | None = None,
 ):
-    """variant x bc x regime (qr tune.cpp sweeps bcMultiplier x grid shape;
-    regime stands in for grid shape on a fixed device set)."""
+    """variant x bc x regime (x grid shape) — qr tune.cpp sweeps
+    bcMultiplier x grid shape; pass grids=grid_space(include_flat=True) to
+    sweep the topology axis on real hardware."""
     prec = None if jnp.dtype(dtype).itemsize < 4 else "highest"
-    for variant, bc, regime in itertools.product(variants, bc_dims, regimes):
+    glist = _with_grids(grids, grid)
+    for g, variant, bc, regime in itertools.product(
+        glist, variants, bc_dims, regimes
+    ):
         cfg = qr.CacqrConfig(
             num_iter=variant,
             regime=regime,
@@ -293,12 +355,16 @@ def cacqr_space(
             precision=prec,
         )
 
-        def step(a, cfg=cfg):
-            Q, R = qr.factor(grid, a, cfg)
+        def step(a, cfg=cfg, g=g):
+            Q, R = qr.factor(g, a, cfg)
             return Q.at[: R.shape[0], : R.shape[1]].add(R.astype(Q.dtype))
 
         cid = f"v{variant}_bc{bc}_{regime}"
-        yield cid, {"variant": variant, "base_case_dim": bc, "regime": regime}, step
+        cdict = {"variant": variant, "base_case_dim": bc, "regime": regime}
+        if len(glist) > 1:
+            cid = f"{_gid(g)}_{cid}"
+            cdict["grid"] = repr(g)
+        yield cid, cdict, step
 
 
 def tune_cholinv(
@@ -316,6 +382,12 @@ def tune_cholinv(
     upgrade over the reference's measure-everything sweep (tune.cpp:239-253)."""
     A = _spd(n, dtype)
     configs = list(cholinv_space(grid, dtype, **space))
+    if prefilter_top_k and any("grid" in c[1] for c in configs):
+        # the native planner models one fixed topology; ranking configs
+        # from different grids against each other with the wrong topology
+        # would silently drop the best one
+        print("# autotune cholinv: --top-k ignored with a grid-shape axis")
+        prefilter_top_k = 0
     if prefilter_top_k and prefilter_top_k < len(configs):
         from capital_tpu import native
 

@@ -71,6 +71,40 @@ def _knobs(args) -> dict:
     return dict(layout=getattr(args, "layout", 0), chunks=getattr(args, "chunks", 0))
 
 
+def _timed(args, step, operand, coupling: str = "full") -> tuple[float, dict]:
+    """timed_loop plus the suite's drift guard (VERDICT r2 weak #4): with
+    args.device_check, the device-counter op total of the same in-jit loop
+    is measured (drift-immune), a wall that lands BELOW it is re-measured
+    (favorable-drift artifact — seen: a 19.0 ms suite row against a 24.7 ms
+    device total), and if it still undercuts after retries the row reports
+    the device floor as its time with the raw wall kept alongside.  The
+    returned extras (device_ms, ...) ride the JSON record."""
+    # ONE jitted loop shared by the wall measurement, the device floor, and
+    # any retries — each _make_loop product is a fresh jit cache entry, and
+    # these fori_loop programs take seconds-to-minutes to trace+compile
+    loop = harness._make_loop(step, coupling)
+    t = harness.timed_loop(
+        step, operand, iters=args.iters, coupling=coupling, loop=loop
+    )
+    extra: dict = {}
+    if getattr(args, "device_check", False):
+        dms = harness.device_ms_per_iter(
+            step, operand, iters=max(3, args.iters), coupling=coupling, loop=loop
+        )
+        if dms > 0.0:
+            extra["device_ms"] = round(dms, 3)
+            tries = 0
+            while t * 1e3 < dms and tries < 2:
+                t = harness.timed_loop(
+                    step, operand, iters=args.iters, coupling=coupling, loop=loop
+                )
+                tries += 1
+            if t * 1e3 < dms:
+                extra["wall_ms_below_floor"] = round(t * 1e3, 3)
+                t = dms / 1e3
+    return t, extra
+
+
 def _resolve_mode(mode: str, grid: Grid) -> str:
     """'auto' picks the best SUMMA mode for the topology: the
     dead-block-skipping pallas kernels on a single TPU (the flagship
@@ -130,11 +164,11 @@ def cholinv(args) -> dict:
         R, Rinv = cholesky.factor(grid, a, cfg)
         return R + Rinv
 
-    t = harness.timed_loop(step, A, iters=args.iters)
+    t, extra = _timed(args, step, A)
     flops = 2.0 * args.n**3 / 3.0  # factor n³/3 + triangular inverse n³/3
     rec = harness.report(
         "cholinv_tflops", t, flops, dtype, n=args.n, grid=repr(grid), bc=args.bc,
-        mode=mode, **_knobs(args),
+        mode=mode, **_knobs(args), **extra,
     )
     if args.validate:
         R, Rinv = jax.jit(lambda a: cholesky.factor(grid, a, cfg))(A)
@@ -191,12 +225,25 @@ def cacqr(args) -> dict:
         # "across 8 ranks"); the single-chip proxy is m=1M.
         return Q.at[: R.shape[0], : R.shape[1]].add(R.astype(Q.dtype))
 
-    t = harness.timed_loop(step, A, iters=args.iters)
+    # single-device pallas WITH the blocked/fused kernels engaged: the
+    # outputs then ride pallas custom calls (Q) and a whole-input potrf
+    # chain (R) that XLA cannot slice into, so the element carry is safe
+    # and saves a Q-sized full-add (~5 ms/iter at 1M x 1024) — see
+    # harness.timed_loop.  When n has no g=2 split the 1d sweep's scale is
+    # a plain jnp.matmul the simplifier COULD narrow to one row under an
+    # element carry, so those shapes keep the full coupling.
+    coupling = (
+        "elem"
+        if (mode == "pallas" and grid.num_devices == 1 and qr._col_blocks(args.n) > 1)
+        else "full"
+    )
+    t, extra = _timed(args, step, A, coupling=coupling)
     # useful flops per sweep: gram mn² + Q·R⁻¹ mn²; CQR2 doubles the sweeps
     flops = 2.0 * args.m * args.n**2 * cfg.num_iter
     rec = harness.report(
         "cacqr_tflops", t, flops, dtype, m=args.m, n=args.n,
         variant=args.variant, grid=repr(grid), mode=mode, **applied_knobs,
+        **extra,
     )
     if args.validate:
         Q, R = jax.jit(lambda a: qr.factor(grid, a, cfg))(A)
@@ -220,11 +267,11 @@ def summa_gemm(args) -> dict:
     # carry must match operand shape: square M=N=K benches only need A
     if not (args.m == args.n == args.k):
         raise SystemExit("summa_gemm bench uses square M=N=K")
-    t = harness.timed_loop(step, A, iters=args.iters)
+    t, extra = _timed(args, step, A)
     rec = harness.report(
         "summa_gemm_tflops", t, 2.0 * args.m * args.n * args.k, dtype,
         m=args.m, n=args.n, k=args.k, grid=repr(grid), mode=mode,
-        **_knobs(args),
+        **_knobs(args), **extra,
     )
     if args.validate:
         C = jax.jit(lambda a: summa.gemm(grid, a, B, args=gargs, mode=mode))(A)
@@ -245,10 +292,10 @@ def rectri(args) -> dict:
     def step(a):
         return inverse.rectri(grid, a, "L", cfg)
 
-    t = harness.timed_loop(step, L, iters=args.iters)
+    t, extra = _timed(args, step, L)
     rec = harness.report(
         "rectri_tflops", t, args.n**3 / 3.0, dtype, n=args.n, grid=repr(grid),
-        mode=mode, **_knobs(args),
+        mode=mode, **_knobs(args), **extra,
     )
     if args.validate:
         Linv = jax.jit(lambda a: inverse.rectri(grid, a, "L", cfg))(L)
@@ -273,7 +320,7 @@ def newton(args) -> dict:
         X, _ = inverse.newton(grid, a, cfg)
         return X
 
-    t = harness.timed_loop(step, A, iters=args.iters)
+    t, extra = _timed(args, step, A)
     # Executed flops, not the budget: the while_loop exits early on
     # convergence (often ~12 of 30 budgeted steps), so scaling by max_iter
     # would inflate TF/s ~2.5x.  Count the actual data-dependent iteration
@@ -285,7 +332,7 @@ def newton(args) -> dict:
     rec = harness.report(
         "newton_tflops", t, flops, dtype, n=args.n, grid=repr(grid),
         iters_executed=newton_iters, max_iters=args.newton_iters, mode=mode,
-        **_knobs(args),
+        **_knobs(args), **extra,
     )
     if args.validate:
         _gate(
@@ -309,11 +356,11 @@ def spd_inverse(args) -> dict:
     def step(a):
         return cholesky.spd_inverse(grid, a, cfg)
 
-    t = harness.timed_loop(step, A, iters=args.iters)
+    t, extra = _timed(args, step, A)
     flops = 2.0 * args.n**3 / 3.0 + args.n**3 / 3.0
     rec = harness.report(
         "spd_inverse_tflops", t, flops, dtype, n=args.n, grid=repr(grid),
-        mode=mode, **_knobs(args),
+        mode=mode, **_knobs(args), **extra,
     )
     if args.validate:
         Ainv = jax.jit(lambda a: cholesky.spd_inverse(grid, a, cfg))(A)
@@ -361,6 +408,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="explicit-SUMMA bcast pipelining chunks (reference num_chunks)",
     )
     p.add_argument("--devices", type=int, default=0, help="limit device count")
+    p.add_argument(
+        "--device-check", action="store_true",
+        help="measure the device-counter op total of the timed loop and "
+        "re-measure (then floor) walls that land below it — the suite's "
+        "drift guard; on by default under the suite driver on TPU",
+    )
     p.add_argument("--newton-iters", type=int, default=30)
     p.add_argument("--no-complete-inv", action="store_true")
     p.add_argument("--validate", action="store_true")

@@ -78,11 +78,68 @@ def paired_median_delta(run, k: int, nrep: int) -> tuple[float, float]:
     return d / k, d
 
 
+def _make_loop(step: Callable, coupling: str):
+    """The one in-jit measurement loop shared by timed_loop and
+    device_ms_per_iter — both must run the SAME program or the device
+    floor would not be the wall's floor."""
+
+    @jax.jit
+    def loop(a, eps, k):
+        def body(_, carry):
+            out = step(carry)
+            e = eps.astype(carry.dtype)
+            if coupling == "elem":
+                return carry.at[0, 0].add(e * out[0, 0])
+            return carry + e * out
+
+        out = jax.lax.fori_loop(0, k, body, a)
+        return jnp.sum(out, dtype=jnp.float32)
+
+    return loop
+
+
+def device_ms_per_iter(
+    step: Callable[[jnp.ndarray], jnp.ndarray],
+    operand: jnp.ndarray,
+    iters: int = 3,
+    coupling: str = "full",
+    loop=None,
+) -> float:
+    """Device-op own-time per iteration of the SAME in-jit loop timed_loop
+    measures, from jax.profiler traces — the drift-immune floor a wall
+    reading must not undercut (a wall below it is a favorable-drift
+    artifact, docs/PERF.md "Measurement discipline").  Measured as a
+    PAIRED DELTA, device_total(iters+1) - device_total(1), exactly like
+    the wall protocol: a single run's total would include the per-call
+    epilogue (the full-operand DCE sum — ~2.6 ms at the 1M x 1024 proxy)
+    that the wall's delta cancels, and the floor would sit above honest
+    walls.  Returns 0.0 when no device plane exists (CPU rigs) — callers
+    skip the guard then.  Pass `loop` (a _make_loop product) to share the
+    compiled program with timed_loop."""
+    from capital_tpu.bench import trace as trace_mod
+
+    loop = loop or _make_loop(step, coupling)
+    eps = jnp.asarray(0.0, jnp.float32)
+    float(loop(operand, eps, 1))  # compile + warm outside the trace
+
+    def total(k: int) -> float:
+        budget = trace_mod.device_budget(lambda: float(loop(operand, eps, k)))
+        budget.pop("async (overlapped)", None)
+        return sum(budget.values())
+
+    try:
+        return max(0.0, (total(iters + 1) - total(1)) / iters)
+    except Exception:
+        return 0.0  # tracing unavailable: no floor, wall stands
+
+
 def timed_loop(
     step: Callable[[jnp.ndarray], jnp.ndarray],
     operand: jnp.ndarray,
     iters: int = 3,
     repeats: int = 3,
+    coupling: str = "full",
+    loop=None,
 ) -> float:
     """Per-iteration seconds of `step`, run `iters` times inside jit —
     the median over interleaved (1-trip, iters+1-trip) wall pairs
@@ -94,26 +151,26 @@ def timed_loop(
     scalar `eps` is 0.0 at call time but runtime-valued, so XLA cannot fold
     the iteration chain away.
 
-    The carry consumes the step output with a FULL-matrix add, deliberately:
-    for arbitrary steps (xla-mode SUMMA, plain matmul chains) a one-element
-    coupling would let the algebraic simplifier legitimately narrow slices
-    into the producing ops and shrink the measured work.  bench.py's flagship
-    loop uses the cheaper element coupling only because its outputs come
-    through chains of aliased pallas custom calls XLA cannot slice through
-    (verified on-device — see the comment there).  The cost: up to ~4 extra
-    HBM passes of harness overhead per iteration, so suite/autotune numbers
-    are slightly conservative.
+    The default carry consumes the step output with a FULL-matrix add,
+    deliberately: for arbitrary steps (xla-mode SUMMA, plain matmul chains)
+    a one-element coupling would let the algebraic simplifier legitimately
+    narrow slices into the producing ops and shrink the measured work.
+    bench.py's flagship loop uses the cheaper element coupling only because
+    its outputs come through chains of aliased pallas custom calls XLA
+    cannot slice through (verified on-device — see the comment there).
+    The cost: up to ~4 extra HBM passes of harness overhead per iteration,
+    so suite/autotune numbers are slightly conservative.
+
+    coupling='elem' opts a driver into the one-element carry
+    (`carry[0,0] += eps·out[0,0]`): ONLY valid when the step's output
+    arrives through ops XLA cannot narrow a slice into — pallas custom
+    calls, full-input consumers like cholesky.  The cacqr pallas driver
+    qualifies (Q is a pallas kernel output; R rides potrf, whose input
+    gram is consumed whole) and its tall Q-sized full-add was ~5 ms/iter
+    of pure harness overhead at the 1M x 1024 BASELINE proxy.
     """
 
-    @jax.jit
-    def loop(a, eps, k):
-        def body(_, carry):
-            out = step(carry)
-            return carry + eps.astype(carry.dtype) * out
-
-        out = jax.lax.fori_loop(0, k, body, a)
-        return jnp.sum(out, dtype=jnp.float32)
-
+    loop = loop or _make_loop(step, coupling)
     eps = jnp.asarray(0.0, jnp.float32)
 
     def run(k: int) -> float:

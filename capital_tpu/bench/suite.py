@@ -33,6 +33,11 @@ def run(base: argparse.Namespace, scale: int = 1) -> list[dict]:
 
     scale = getattr(base, "scale", scale) or scale
     ndev = len(jax.devices())
+    # drift guard on by default where drift exists (the TPU tunnel):
+    # suite rows carry device_ms and a wall may never undercut it
+    # (VERDICT r2 weak #4; harmless no-op on CPU rigs — no device plane)
+    if jax.default_backend() == "tpu":
+        base.device_check = True
     out = []
 
     def go(name, fn, **over):

@@ -43,7 +43,8 @@ import jax.numpy as jnp
 #: catch-alls that caught the round-2 relayout-copy regressions.
 PHASE_TAGS = (
     "CI.factor_diag", "CI.trsm", "CI.tmu", "CI.inv",
-    "CQR.gram", "CQR.chol", "CQR.scale", "CQR.merge",
+    "CQR.gram", "CQR.chol", "CQR.scale", "CQR.merge", "CQR.fused",
+    "CQR.formR",
     "RT.base", "RT.merge",
 )
 

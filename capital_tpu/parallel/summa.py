@@ -97,6 +97,90 @@ class SyrkArgs:
 # --------------------------------------------------------------------------
 
 
+def _seg_live_a_global(xi, s, ch, mb, lk, w, a_uplo):
+    # A columns of (segment s, chunk ch): [s*lk + ch*w, +w); rows of this
+    # device's block: [xi*mb, +mb).  Live = intersects the stored triangle.
+    lo = s * lk + ch * w
+    if a_uplo == "U":
+        return xi * mb < lo + w  # ∃ row <= col
+    return (xi + 1) * mb - 1 >= lo  # 'L': ∃ row >= col
+
+
+def _seg_live_b_global(yi, s, ch, nb, lk, w, b_uplo):
+    # B rows of (segment s, chunk ch); cols of this block: [yi*nb, +nb)
+    lo = s * lk + ch * w
+    if b_uplo == "U":
+        return lo < (yi + 1) * nb
+    return lo + w - 1 >= yi * nb
+
+
+def tri_fractions(
+    grid: Grid,
+    M: int,
+    K: int,
+    N: int,
+    a_uplo: str | None = None,
+    b_uplo: str | None = None,
+    out_uplo: str | None = None,
+) -> tuple[float, float]:
+    """(mean_frac, max_frac) of the dense per-device contraction that the
+    explicit schedule actually EXECUTES under dead-segment/dead-output
+    skipping, by enumerating the same liveness predicates the schedule
+    compiles in (the functions above — one source of truth).
+
+    mean = volumetric view; max = the critical-path device.  With block
+    distribution a triangular operand leaves the fullest block row
+    executing every segment (max_frac = 1.0) while the emptiest runs ~1/d
+    — the load imbalance the reference's element-cyclic distribution
+    (structure.hpp:80-85) avoids by construction.  Used for the
+    flops_vol/flops_max columns of the cost model (VERDICT r2 #4)."""
+    d, c = grid.dx, grid.c
+    if grid.num_devices == 1 or (a_uplo is None and b_uplo is None and out_uplo is None):
+        return 1.0, 1.0
+    if grid.dy != d or d % max(1, c) or M % d or K % d or N % d:
+        return 1.0, 1.0  # shapes the explicit schedule would reject: dense model
+    q = max(1, grid.num_chunks)
+    lk = K // d
+    if lk % q:
+        return 1.0, 1.0
+    w = lk // q
+    mb, nb = M // d, N // d
+    spl = d // c
+    fracs = []
+    for zi in range(c):
+        segs = (
+            range(d) if c == 1 else [zi * spl + i for i in range(spl)]
+        )
+        denom = len(segs) * q
+        for xi in range(d):
+            for yi in range(d):
+                if out_uplo is not None:
+                    o_live = (
+                        xi * mb < (yi + 1) * nb
+                        if out_uplo == "U"
+                        else (xi + 1) * mb - 1 >= yi * nb
+                    )
+                    if not o_live:
+                        fracs.append(0.0)
+                        continue
+                live = 0
+                for s in segs:
+                    for ch in range(q):
+                        la = (
+                            _seg_live_a_global(xi, s, ch, mb, lk, w, a_uplo)
+                            if a_uplo is not None
+                            else True
+                        )
+                        lb = (
+                            _seg_live_b_global(yi, s, ch, nb, lk, w, b_uplo)
+                            if b_uplo is not None
+                            else True
+                        )
+                        live += bool(la and lb)
+                fracs.append(live / denom)
+    return sum(fracs) / len(fracs), max(fracs)
+
+
 def _explicit_matmul(
     grid: Grid,
     A: jnp.ndarray,
@@ -184,19 +268,10 @@ def _explicit_matmul(
     acc_dtype = jnp.promote_types(wire_dtype, jnp.float32)
 
     def _seg_live_a(xi, s, ch):
-        # A columns of (segment s, chunk ch): [s*lk + ch*w, +w); rows of this
-        # device's block: [xi*mb, +mb).  Live = intersects the stored triangle.
-        lo = s * lk + ch * w
-        if a_uplo == "U":
-            return xi * mb < lo + w  # ∃ row <= col
-        return (xi + 1) * mb - 1 >= lo  # 'L': ∃ row >= col
+        return _seg_live_a_global(xi, s, ch, mb, lk, w, a_uplo)
 
     def _seg_live_b(yi, s, ch):
-        # B rows of (segment s, chunk ch); cols of this block: [yi*nb, +nb)
-        lo = s * lk + ch * w
-        if b_uplo == "U":
-            return lo < (yi + 1) * nb
-        return lo + w - 1 >= yi * nb
+        return _seg_live_b_global(yi, s, ch, nb, lk, w, b_uplo)
 
     def kernel(a, b):
         # a: (M/d, K/d) block at (x, y);  b: (K/d, N/d) block at (x, y)
@@ -349,16 +424,24 @@ def _matmul(
 ) -> jnp.ndarray:
     """The uplo flags describe triangular structure of the (already masked)
     operands/result; only mode='explicit' exploits them (dead K-segments /
-    dead output blocks skipped per device).  Emitted model flops stay the
-    dense count: with block distribution the *critical-path* device still
-    executes a full contraction (see _explicit_matmul docstring) — the
-    skipping is a volumetric saving the one-number-per-phase model does not
-    track."""
+    dead output blocks skipped per device).  The homogeneous model count
+    (`flops`) stays dense; the executed views carry the skipping:
+    flops_vol (mean over devices) and flops_max (the critical-path device,
+    which with block distribution still runs up to the full contraction —
+    see tri_fractions)."""
     # cost-model attribution (no-op without an active tracing.Recorder)
+    M, K, N = A.shape[0], A.shape[1], B.shape[1]
     flops, comm, ncoll = tracing.gemm_cost(
-        grid, A.shape[0], B.shape[1], A.shape[1], jnp.result_type(A, B)
+        grid, M, N, K, jnp.result_type(A, B)
     )
-    tracing.emit(flops=flops, comm_bytes=comm, collectives=ncoll)
+    if mode == "explicit":
+        mean_f, max_f = tri_fractions(grid, M, K, N, a_uplo, b_uplo, out_uplo)
+    else:
+        mean_f = max_f = 1.0  # dense+mask executes the full contraction
+    tracing.emit(
+        flops=flops, comm_bytes=comm, collectives=ncoll,
+        flops_vol=flops * mean_f, flops_max=flops * max_f,
+    )
     if mode in ("xla", "pallas"):  # gemm has no dead blocks: XLA is optimal
         return grid.pin(jnp.matmul(grid.pin(A), grid.pin(B), precision=precision))
     if mode == "explicit":

@@ -1,0 +1,99 @@
+"""Fused CQR2 tall-pass kernels (ops/qr_fused.py) — interpret mode on CPU.
+
+The fused pipeline must agree with the unfused blocked pipeline (same
+grams-from-rounded-Q math, different reduction association) and pass the
+reference residual gates."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from capital_tpu.models import qr
+from capital_tpu.models.qr import CacqrConfig
+from capital_tpu.ops import qr_fused
+from capital_tpu.parallel.topology import Grid
+from capital_tpu.utils import rand48, residual
+
+
+@pytest.fixture(scope="module")
+def grid1():
+    return Grid.square(c=1, devices=jax.devices("cpu")[:1])
+
+
+def _tall(m, n, key=11):
+    return jnp.asarray(rand48.random(m, n, key=key))
+
+
+class TestKernels:
+    def test_gram_blocked_matches_dense(self):
+        A = _tall(2048, 512).astype(jnp.float32)
+        Gu = qr_fused.gram_blocked(A, bm=512)
+        G = qr_fused.assemble_sym(Gu, 256)
+        want = np.asarray(A, np.float64).T @ np.asarray(A, np.float64)
+        np.testing.assert_allclose(np.asarray(G), want, rtol=1e-5, atol=1e-4)
+        # lower-left of the raw form is zero (never computed)
+        np.testing.assert_array_equal(np.asarray(Gu)[256:, :256], 0.0)
+
+    def test_scale_gram_matches_separate(self):
+        rng = np.random.default_rng(5)
+        A = _tall(1024, 512, key=7).astype(jnp.float32)
+        Rinv = jnp.asarray(
+            np.triu(rng.standard_normal((512, 512)) * 0.1 + np.eye(512))
+        ).astype(jnp.float32)
+        Q, Gu = qr_fused.scale_gram(A, Rinv, bm=512)
+        wantQ = np.asarray(A, np.float64) @ np.asarray(Rinv, np.float64)
+        np.testing.assert_allclose(np.asarray(Q), wantQ, rtol=1e-4, atol=1e-4)
+        # the gram is of the ROUNDED Q (the contract: sweep 2 sees what it
+        # would have re-read)
+        Qr = np.asarray(Q, np.float64)
+        G = qr_fused.assemble_sym(Gu, 256)
+        np.testing.assert_allclose(
+            np.asarray(G), Qr.T @ Qr, rtol=1e-5, atol=1e-4
+        )
+
+    def test_shape_gates(self):
+        A = _tall(1000, 512).astype(jnp.float32)  # 1000 not tileable
+        with pytest.raises(ValueError):
+            qr_fused.gram_blocked(A, bm=512)
+        g1 = Grid.square(c=1, devices=jax.devices("cpu")[:1])
+        assert not qr_fused.fused_ok(g1, 1000, 512, "pallas")
+        assert not qr_fused.fused_ok(g1, 1024, 192, "pallas")  # no g=2 split
+        assert not qr_fused.fused_ok(g1, 1024, 512, "xla")
+        assert qr_fused.fused_ok(g1, 1024, 512, "pallas")
+
+
+class TestFusedPipeline:
+    def test_fused_cqr2_matches_unfused(self, grid1):
+        A = _tall(2048, 512).astype(jnp.float64)
+        fused_cfg = CacqrConfig(num_iter=2, regime="1d", mode="pallas")
+        assert qr_fused.fused_ok(grid1, *A.shape, "pallas")
+        Qf, Rf = jax.jit(lambda a: qr.factor(grid1, a, fused_cfg))(A)
+        # unfused reference: xla mode takes the separate-pass pipeline
+        Qu, Ru = jax.jit(
+            lambda a: qr.factor(grid1, a, CacqrConfig(num_iter=2, regime="1d"))
+        )(A)
+        np.testing.assert_allclose(np.asarray(Qf), np.asarray(Qu), atol=1e-10)
+        np.testing.assert_allclose(
+            np.triu(np.asarray(Rf)), np.triu(np.asarray(Ru)), atol=1e-8
+        )
+        assert float(residual.qr_orthogonality(Qf)) < 1e-14
+        assert float(residual.qr_residual(A, Qf, Rf)) < 1e-13
+
+    def test_fused_bf16_gates(self, grid1):
+        A = _tall(1024, 512).astype(jnp.bfloat16)
+        cfg = CacqrConfig(num_iter=2, regime="1d", mode="pallas")
+        Q, R = jax.jit(lambda a: qr.factor(grid1, a, cfg))(A)
+        assert float(residual.qr_orthogonality(Q)) < 5e-2
+        assert float(residual.qr_residual(A, Q, R)) < 5e-2
+
+    def test_cqr1_and_multidevice_stay_unfused(self, grid_flat8, grid1):
+        # num_iter=1 and mesh grids must keep the existing paths
+        A = _tall(1024, 512).astype(jnp.float64)
+        cfg1 = CacqrConfig(num_iter=1, regime="1d", mode="pallas")
+        Q, R = qr.factor(grid1, A, cfg1)
+        assert float(residual.qr_residual(A, Q, R)) < 1e-13
+        Ad = jax.device_put(A, grid_flat8.rows_sharding())
+        cfgm = CacqrConfig(num_iter=2, regime="1d", mode="pallas")
+        Qm, Rm = jax.jit(lambda a: qr.factor(grid_flat8, a, cfgm))(Ad)
+        assert float(residual.qr_orthogonality(Qm)) < 1e-13

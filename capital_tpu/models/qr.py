@@ -329,6 +329,18 @@ def solve_blocked(
 # --------------------------------------------------------------------------
 
 
+def pallas_coupled(grid: Grid, n: int, mode: str) -> bool:
+    """True when a 1d factor's outputs ride ops XLA cannot slice into (Q
+    through pallas custom calls — the blocked/fused kernels engaged — and R
+    through a whole-input potrf chain), making a one-element benchmark
+    carry measurement-safe (harness.timed_loop coupling='elem').  Lives
+    HERE, next to the kernel gating it mirrors (_sweep_1d's tri_kernel +
+    qr_fused.fused_ok): if the routing changes, this predicate must change
+    with it — a stale copy in a driver would let the simplifier silently
+    narrow the measured work."""
+    return mode == "pallas" and grid.num_devices == 1 and _col_blocks(n) > 1
+
+
 def _pick_regime(grid: Grid, n: int, cfg: CacqrConfig) -> str:
     if cfg.regime != "auto":
         return cfg.regime

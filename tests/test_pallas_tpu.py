@@ -290,6 +290,37 @@ def test_cholinv_schur_in_place_matches_default(grid1):
         assert float(residual.cholesky_residual(A, R1)) < 1e-13
 
 
+def test_cholinv_out_buffers_reuse(grid1):
+    """factor(out_buffers=...): factoring into a PREVIOUS factor's outputs
+    (the benchmark-loop carry that kills the hoisted-zeros copies) must give
+    exactly the fresh-buffer result — every upper tile rewritten, dead lower
+    zeros preserved."""
+    # n = bc·2^k shapes only: with padding (p != n) factor returns CROPPED
+    # arrays that cannot serve as the next call's p x p buffers
+    for n, bc, mode in ((512, 128, "pallas"), (256, 64, "xla")):
+        cfg = cholesky.CholinvConfig(base_case_dim=bc, mode=mode)
+        A1 = jnp.asarray(rand48.symmetric(n))
+        A2 = jnp.asarray(rand48.symmetric(n)) + 0.5 * jnp.eye(n)
+
+        def chain(a1, a2):
+            bufs = cholesky.factor_buffers(grid1, n, a1.dtype, cfg)
+            R1, RI1 = cholesky.factor(grid1, a1, cfg, out_buffers=bufs)
+            # second factor reuses the first's outputs as its buffers
+            return cholesky.factor(grid1, a2, cfg, out_buffers=(R1, RI1))
+
+        R2, RI2 = jax.jit(chain)(A1, A2)
+        Rf, RIf = jax.jit(lambda a: cholesky.factor(grid1, a, cfg))(A2)
+        np.testing.assert_array_equal(np.asarray(R2), np.asarray(Rf))
+        np.testing.assert_array_equal(np.asarray(RI2), np.asarray(RIf))
+    # contract violations are rejected
+    cfg = cholesky.CholinvConfig(base_case_dim=64, complete_inv=False)
+    with pytest.raises(ValueError, match="complete_inv"):
+        cholesky.factor(
+            grid1, jnp.asarray(rand48.symmetric(128)), cfg,
+            out_buffers=(jnp.zeros((128, 128)), jnp.zeros((128, 128))),
+        )
+
+
 def test_cholinv_pallas_mode_aligned_views(grid1):
     """bc=128 at n=512: every window size/offset is a multiple of 128, so
     this drives the ALIGNED in-place path end to end — offset index maps for

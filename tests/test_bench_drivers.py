@@ -62,6 +62,34 @@ def test_flagship_auto_base_case(capsys):
     assert "padding to" in capsys.readouterr().err
 
 
+def test_flagship_spd_hash_contract():
+    """The one-shot loop's fused operand generator: exactly symmetric (hash
+    of (min, max) index pair), well-SPD (3I shift vs ~1.16 spectral norm of
+    the random part), and salt-dependent (so XLA cannot hoist it out of the
+    timed loop)."""
+    import importlib.util
+    import pathlib
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    path = pathlib.Path(__file__).resolve().parent.parent / "bench.py"
+    spec = importlib.util.spec_from_file_location("flagship_bench2", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    A = np.asarray(mod.spd_hash(256, jnp.float32, 3))
+    np.testing.assert_array_equal(A, A.T)
+    w = np.linalg.eigvalsh(A.astype(np.float64))
+    assert w.min() > 1.0 and w.max() < 5.0  # 3 ± ~1.16 spectral band
+    B = np.asarray(mod.spd_hash(256, jnp.float32, 4))
+    assert np.abs(A - B).max() > 0.01  # salt actually changes the operand
+    # deterministic: same salt, same matrix
+    np.testing.assert_array_equal(
+        A, np.asarray(mod.spd_hash(256, jnp.float32, 3))
+    )
+
+
 def test_newton_reports_executed_iters():
     """VERDICT r2 weak #3: the newton driver must report flops for the
     iterations actually executed (early exit), not the max_iter budget —

@@ -1,0 +1,65 @@
+"""Unit tests for the device-trace budget tool (capital_tpu/bench/trace.py).
+
+The own-time sweep and phase bucketing are pure logic — testable without a
+TPU by synthesizing xplane protos."""
+
+import types
+
+import pytest
+
+pytest.importorskip("tensorflow.tsl.profiler.protobuf.xplane_pb2")
+from tensorflow.tsl.profiler.protobuf import xplane_pb2  # noqa: E402
+
+from capital_tpu.bench import trace  # noqa: E402
+
+
+def _line(events):
+    """events: [(offset_ps, duration_ps, metadata_id)]"""
+    line = xplane_pb2.XLine(name="XLA Ops")
+    for off, dur, mid in events:
+        line.events.add(offset_ps=off, duration_ps=dur, metadata_id=mid)
+    return line
+
+
+class TestOwnTimes:
+    def test_nested_subtraction(self):
+        # while[0,100] contains a[10,30] and b[50,40]; a contains c[15,10]
+        line = _line([(0, 100, 1), (10, 30, 2), (15, 10, 3), (50, 40, 4)])
+        own = dict(trace._own_times(line))
+        assert own == {1: 30, 2: 20, 3: 10, 4: 40}
+
+    def test_flat_events(self):
+        line = _line([(0, 10, 1), (10, 10, 2), (25, 5, 3)])
+        own = dict(trace._own_times(line))
+        assert own == {1: 10, 2: 10, 3: 5}
+
+    def test_total_is_conserved(self):
+        # sum of own times == duration of the outermost container
+        line = _line([(0, 1000, 1), (0, 400, 2), (400, 600, 3), (450, 100, 4)])
+        own = trace._own_times(line)
+        assert sum(t for _, t in own) == 1000
+
+
+class TestBucket:
+    def _md(self, name, display=""):
+        return xplane_pb2.XEventMetadata(name=name, display_name=display)
+
+    def test_phase_from_op_name_wins_over_stats(self):
+        # op NAME is authoritative: %CI.tmu.90 goes to CI::tmu even if the
+        # stats were to mention other scopes (the round-3 mis-filing bug)
+        md = self._md("%CI.tmu.90 = bf16[64,64] fusion(...)", "CI.tmu.90")
+        assert trace._bucket(md, {}) == "CI::tmu"
+        md2 = self._md("%CI.factor_diag.3 = f32[128,128] custom-call(...)")
+        assert trace._bucket(md2, {}) == "CI::factor_diag"
+
+    def test_kind_fallbacks(self):
+        assert trace._bucket(self._md("%copy.1 = bf16[8,8] copy(%x)"), {}) == "copy"
+        assert (
+            trace._bucket(self._md("%fusion.2 = bf16[8,8] fusion(%x)"), {})
+            == "fusion"
+        )
+        assert (
+            trace._bucket(self._md("%custom-call.9 = f32[8,8] custom-call()"), {})
+            == "custom-call"
+        )
+        assert trace._bucket(self._md("%add.1 = f32[8] add(%a, %b)"), {}) == "other"

@@ -133,6 +133,25 @@ def tile_cyclic_perm(m: int, d: int, tile: int):
     return perm, inv
 
 
+def _pick_cyclic_tile(grid: Grid, dim: int, override: int) -> int:
+    """The ONE tile auto-pick + eligibility rule for balance='tile_cyclic'
+    (trmm rows / syrk output): ~4 local tiles per device unless overridden;
+    returns 0 when the topology/shape cannot take the cyclic schedule
+    (c==1 square faces with d>1, tile tiling the global dim)."""
+    d = grid.dx
+    tile = override
+    if tile == 0 and d > 1 and (dim // d) % 4 == 0:
+        tile = dim // d // 4
+    ok = (
+        grid.c == 1
+        and grid.dx == grid.dy
+        and d > 1
+        and tile > 0
+        and dim % (d * tile) == 0
+    )
+    return tile if ok else 0
+
+
 def tri_fractions(
     grid: Grid,
     M: int,
@@ -142,6 +161,7 @@ def tri_fractions(
     b_uplo: str | None = None,
     out_uplo: str | None = None,
     cyclic_rows: int = 0,
+    cyclic_out: int = 0,
 ) -> tuple[float, float]:
     """(mean_frac, max_frac) of the dense per-device contraction that the
     explicit schedule actually EXECUTES under dead-segment/dead-output
@@ -188,6 +208,29 @@ def tri_fractions(
                         )
             fracs.append(live / (ntl * d * q))
         return sum(fracs) / len(fracs), max(fracs)
+    if cyclic_out:
+        # balanced tri-output (syrk): per local output TILE PAIR liveness
+        # against original tile indices (gi, gj) — same predicate as the
+        # compiled cyclic_out schedule
+        tile = cyclic_out
+        if (
+            c != 1 or out_uplo is None or a_uplo is not None
+            or b_uplo is not None or M != N or mb % tile
+        ):
+            return 1.0, 1.0
+        ntl = mb // tile
+        fracs = []
+        for xi in range(d):
+            for yi in range(d):
+                live = sum(
+                    (ti * d + xi <= tj * d + yi)
+                    if out_uplo == "U"
+                    else (ti * d + xi >= tj * d + yi)
+                    for ti in range(ntl)
+                    for tj in range(ntl)
+                )
+                fracs.append(live / (ntl * ntl))
+        return sum(fracs) / len(fracs), max(fracs)
     fracs = []
     for zi in range(c):
         segs = (
@@ -232,6 +275,7 @@ def _explicit_matmul(
     b_uplo: str | None = None,
     out_uplo: str | None = None,
     cyclic_rows: int = 0,
+    cyclic_out: int = 0,
 ) -> jnp.ndarray:
     """C = A @ B with the explicit SUMMA schedule on the d x d x c grid.
 
@@ -316,6 +360,22 @@ def _explicit_matmul(
             raise ValueError(
                 f"cyclic tile {cyclic_rows} must divide the local rows {M // d}"
             )
+    if cyclic_out:
+        # tile-cyclic SYMMETRIC-output balance (syrk): BOTH output axes are
+        # in tile_cyclic_perm order (C_p = A_pᵀA_p with A's columns
+        # permuted), so local output tile (ti, tj) on device (xi, yi) is
+        # ORIGINAL tile pair (ti*d + xi, tj*d + yi) and the dead-triangle
+        # skip tests original indices — every device carries ~half the
+        # tile pairs regardless of position
+        if c != 1 or out_uplo is None or a_uplo is not None or b_uplo is not None:
+            raise ValueError(
+                "cyclic_out supports the c==1 tri-output (syrk) schedule only"
+            )
+        if (M // d) % cyclic_out or (N // d) % cyclic_out or M != N:
+            raise ValueError(
+                f"cyclic_out tile {cyclic_out} must tile the square local "
+                f"block {(M // d, N // d)}"
+            )
 
     spl = d // c  # K-segments owned by each depth layer
     q = max(1, grid.num_chunks)
@@ -388,7 +448,34 @@ def _explicit_matmul(
                 b_ch = lax.all_gather(
                     b[ch * w : (ch + 1) * w, :], "x", axis=0, tiled=True
                 )
-                if a_uplo is None and b_uplo is None:
+                if cyclic_out:
+                    # balanced tri-output skipping: per LOCAL OUTPUT TILE
+                    # PAIR — original tile pair (gi, gj) is live iff it
+                    # touches the stored triangle of the UN-permuted C
+                    T = cyclic_out
+                    for ti in range(mb // T):
+                        gi = ti * d + xi
+                        a_t = lax.slice_in_dim(a_ch, ti * T, (ti + 1) * T, axis=0)
+                        for tj in range(nb // T):
+                            gj = tj * d + yi
+                            live = gi <= gj if out_uplo == "U" else gi >= gj
+                            tile_mm = guarded(
+                                live,
+                                lambda a_=a_t, tj_=tj: jnp.matmul(
+                                    a_,
+                                    lax.slice_in_dim(
+                                        b_ch, tj_ * T, (tj_ + 1) * T, axis=1
+                                    ),
+                                    precision=precision,
+                                    preferred_element_type=acc_dtype,
+                                ),
+                                a_t, b_ch,
+                                shape=(T, T),
+                            )
+                            acc = acc.at[
+                                ti * T : (ti + 1) * T, tj * T : (tj + 1) * T
+                            ].add(tile_mm)
+                elif a_uplo is None and b_uplo is None:
                     acc = acc + matmul_term(out_live, a_ch, b_ch)
                 elif cyclic_rows:
                     # balanced skipping: per LOCAL ROW-TILE x segment —
@@ -514,6 +601,7 @@ def _matmul(
     b_uplo: str | None = None,
     out_uplo: str | None = None,
     cyclic_rows: int = 0,
+    cyclic_out: int = 0,
 ) -> jnp.ndarray:
     """The uplo flags describe triangular structure of the (already masked)
     operands/result; only mode='explicit' exploits them (dead K-segments /
@@ -529,7 +617,8 @@ def _matmul(
     )
     if mode == "explicit":
         mean_f, max_f = tri_fractions(
-            grid, M, K, N, a_uplo, b_uplo, out_uplo, cyclic_rows=cyclic_rows
+            grid, M, K, N, a_uplo, b_uplo, out_uplo,
+            cyclic_rows=cyclic_rows, cyclic_out=cyclic_out,
         )
     else:
         mean_f = max_f = 1.0  # dense+mask executes the full contraction
@@ -541,7 +630,8 @@ def _matmul(
         return grid.pin(jnp.matmul(grid.pin(A), grid.pin(B), precision=precision))
     if mode == "explicit":
         return _explicit_matmul(
-            grid, A, B, precision, a_uplo, b_uplo, out_uplo, cyclic_rows
+            grid, A, B, precision, a_uplo, b_uplo, out_uplo, cyclic_rows,
+            cyclic_out,
         )
     raise ValueError(f"unknown summa mode {mode!r}")
 
@@ -652,22 +742,14 @@ def trmm(
     )
     res = None
     if balance == "tile_cyclic":
-        M = Top.shape[0] if args.side == "L" else None
-        d = grid.dx
-        tile = cyclic_tile
-        if M is not None and tile == 0 and d > 1 and (M // d) % 4 == 0:
-            tile = M // d // 4  # ~4 local tiles/device: balanced yet chunky
-        ok = (
-            mode == "explicit"
-            and args.side == "L"
-            and grid.c == 1
-            and grid.dx == grid.dy
-            and d > 1
-            and tile > 0
-            and M % (d * tile) == 0
+        M = Top.shape[0] if args.side == "L" else 0
+        tile = (
+            _pick_cyclic_tile(grid, M, cyclic_tile)
+            if (mode == "explicit" and args.side == "L")
+            else 0
         )
-        if ok:
-            perm, inv = tile_cyclic_perm(M, d, tile)
+        if tile:
+            perm, inv = tile_cyclic_perm(M, grid.dx, tile)
             # two row-shuffles priced like grid transposes (block
             # exchanges across the face): the M x M triangular operand in,
             # the M x N product out
@@ -706,6 +788,8 @@ def syrk(
     a_view: tuple[int, int, int, int] | None = None,
     c_view: tuple[int, int, int, int] | None = None,
     in_place: bool = False,
+    balance: str = "block",
+    cyclic_tile: int = 0,
 ) -> jnp.ndarray:
     """Symmetric rank-k update (reference summa.hpp:86-161, which lowers syrk
     to an explicit grid transpose + gemm; here the transpose is a logical
@@ -765,21 +849,54 @@ def syrk(
             **out_kw,
         )
     Aw = _take_view(A, a_view)
-    Aop = (Aw.T, Aw) if args.trans else (Aw, Aw.T)
+    if balance == "tile_cyclic" and mode != "explicit":
+        # xla/pallas modes have no balanced schedule to route to — say so
+        # in the recorder instead of silently dropping the request (same
+        # contract as trmm's fallback note)
+        tracing.note("syrk::tile_cyclic_fallback")
     if mode == "explicit":
         # compute only the args.uplo triangle's blocks (devices with a fully
         # dead C block skip all local flops), then symmetrize — one grid
         # transpose, the same data motion the reference's syrk-via-transpose
         # already pays (summa.hpp:86-161); the dense-result contract of this
-        # mode is preserved
+        # mode is preserved.
+        # balance='tile_cyclic': C's OUTPUT tile indices are block-cyclic
+        # over devices (permute A's free axis in, un-permute C's rows+cols
+        # out), so every device carries ~half the live tile pairs instead
+        # of whole blocks being dead — the syrk analog of trmm's balanced
+        # schedule (see trmm's docstring; same decision calculus).
+        cyc = 0
+        perm = inv = None
+        if balance == "tile_cyclic":
+            n_out = Aw.shape[1] if args.trans else Aw.shape[0]
+            T = _pick_cyclic_tile(grid, n_out, cyclic_tile)
+            if T:
+                perm, inv = tile_cyclic_perm(n_out, grid.dx, T)
+                pj = jnp.asarray(perm)
+                Aw = Aw[:, pj] if args.trans else Aw[pj, :]
+                cyc = T
+                # three shuffles, each priced at its true shape: the whole
+                # A operand in, then C's rows AND cols out (two n_out²
+                # motions — D[inv][:, inv])
+                ca, na = tracing.transpose_cost(grid, *Aw.shape, Aw.dtype)
+                cc, nc = tracing.transpose_cost(grid, n_out, n_out, Aw.dtype)
+                tracing.emit(comm_bytes=ca + 2 * cc, collectives=na + 2 * nc)
+            else:
+                tracing.note("syrk::tile_cyclic_fallback")
+        Aop = (Aw.T, Aw) if args.trans else (Aw, Aw.T)
         D = _matmul(
-            grid, Aop[0], Aop[1], mode, args.precision, out_uplo=args.uplo
+            grid, Aop[0], Aop[1], mode, args.precision, out_uplo=args.uplo,
+            cyclic_out=cyc,
         )
+        if cyc:
+            ij = jnp.asarray(inv)
+            D = grid.pin(D[ij][:, ij])
         if args.uplo == "U":
             out = jnp.triu(D) + transpose(grid, jnp.triu(D, 1))
         else:
             out = jnp.tril(D) + transpose(grid, jnp.tril(D, -1))
     else:
+        Aop = (Aw.T, Aw) if args.trans else (Aw, Aw.T)
         out = _matmul(grid, Aop[0], Aop[1], mode, args.precision)
     if args.alpha != 1.0:
         out = args.alpha * out

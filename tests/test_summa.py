@@ -407,6 +407,47 @@ class TestTileCyclicBalance:
             # volumetric work is conserved up to tile-boundary rounding
             assert cm == pytest.approx(bm, abs=1.0 / (2 * d))
 
+    def test_syrk_tile_cyclic_matches_block(self, grid2x2x1):
+        g = grid2x2x1
+        A = jax.device_put(
+            jnp.asarray(rand48.random(64, 64, key=41)), g.face_sharding()
+        )
+        for trans in (True, False):
+            for uplo in ("U", "L"):
+                args = SyrkArgs(trans=trans, uplo=uplo, alpha=0.5)
+                blocked = jax.jit(
+                    lambda a, ar=args: summa.syrk(g, a, args=ar, mode="explicit")
+                )(A)
+                cyc = jax.jit(
+                    lambda a, ar=args: summa.syrk(
+                        g, a, args=ar, mode="explicit", balance="tile_cyclic"
+                    )
+                )(A)
+                An = np.asarray(A)
+                ref = 0.5 * (An.T @ An if trans else An @ An.T)
+                np.testing.assert_allclose(np.asarray(cyc), ref, atol=1e-12)
+                np.testing.assert_allclose(
+                    np.asarray(cyc), np.asarray(blocked), atol=1e-12
+                )
+
+    def test_syrk_balance_in_cost_model(self):
+        import types
+
+        for d in (2, 4):
+            g = types.SimpleNamespace(
+                dx=d, dy=d, c=1, num_chunks=0, num_devices=d * d
+            )
+            n = 64
+            T = n // d // 4
+            bm, bx = summa.tri_fractions(g, n, n, n, out_uplo="U")
+            cm, cx = summa.tri_fractions(g, n, n, n, out_uplo="U", cyclic_out=T)
+            # block layout: some device's C block is fully live (max=1.0),
+            # another's fully dead; cyclic: every device ~half the pairs
+            assert bx == 1.0
+            assert cx < 0.7
+            assert cx - cm <= 2.0 / (4 * d)
+            assert cm == pytest.approx(bm, abs=1.0 / (2 * d))
+
     def test_unsupported_combinations_fall_back(self, grid2x2x2):
         # c=2 grid: tile_cyclic is c==1-only — must still produce correct
         # results through the block fallback (with a tracing note)

@@ -287,8 +287,23 @@ def rectri(args) -> dict:
     grid = _grid(args)
     mode = _resolve_mode(args.mode, grid)
     dtype = jnp.dtype(args.dtype)
-    A = _spd(args.n, jnp.float32)
-    L = jnp.linalg.cholesky(A).astype(dtype)
+
+    # well-conditioned triangular operand built DIRECTLY at dtype (no
+    # chol-of-SPD setup — its two extra f32 n² staging buffers OOM'd the
+    # n=32768 row on one v5e).  Off-diagonal scale 1/sqrt(n): kappa ~ 2 at
+    # every n (measured 1.9-2.0 at 512-8192 in f64) while the off-diagonal
+    # part carries ~23% of the matrix norm, so the --validate residual
+    # gate still SEES off-diagonal bugs — a 1/n scale would shrink them
+    # ~sqrt(n)x below the bf16 tolerance
+    @jax.jit
+    def _make(key):
+        G = jax.random.normal(key, (args.n, args.n), dtype=jnp.float32)
+        L = jnp.tril(G, -1) / jnp.sqrt(
+            jnp.asarray(args.n, jnp.float32)
+        ) + 3.0 * jnp.eye(args.n, dtype=jnp.float32)
+        return L.astype(dtype)
+
+    L = jax.block_until_ready(_make(jax.random.key(0)))
     cfg = inverse.RectriConfig(base_case_dim=args.bc, mode=mode)
 
     def step(a):

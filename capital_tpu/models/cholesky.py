@@ -88,6 +88,16 @@ class CholinvConfig:
     # TPU: 'highest' keeps the trmm/syrk phases at full f32 (the MXU default
     # of bf16 passes costs ~3 decimal digits in the factor); set None to
     # inherit the context default when chasing raw throughput
+    balance: str = "block"  # 'tile_cyclic' routes the EXPLICIT-mode
+    # trmm/syrk phases through the tile-cyclic balanced schedules
+    # (parallel/summa.py) for windows >= balance_min_window: the
+    # critical-path device then executes ~the volumetric mean instead of
+    # the full dense contraction.  Per-call row-shuffles are O(window²)
+    # against O(window³) compute, so only large windows net positive —
+    # small ones keep the block schedule (and side-R completion trmms
+    # always do; the balanced form is side-L/syrk only).  No effect
+    # outside explicit mode.
+    balance_min_window: int = 8192
     schur_in_place: bool = False  # write each Schur complement back into the
     # input buffer (summa.syrk in_place) instead of materializing the
     # Σ(n/2ᵏ)² ≈ n²/3 chain of fresh trailing windows.  Peak memory drops
@@ -362,6 +372,20 @@ def _recurse(
     # n=49152 — 27.02G of 15.75G — from exactly this).
     buf, Rp, RIp = _recurse(grid, buf, off, left, cfg, False, Rp, RIp)
 
+    # balanced schedules for the large explicit-mode windows (see
+    # CholinvConfig.balance); summa falls back with a note where the
+    # balanced form does not apply
+    def _bal(win: int) -> str:
+        return (
+            "tile_cyclic"
+            if (
+                cfg.balance == "tile_cyclic"
+                and cfg.mode == "explicit"
+                and win >= cfg.balance_min_window
+            )
+            else "block"
+        )
+
     # 2. TRSM phase: R12 = R11⁻ᵀ · A12 (cholinv.hpp:116-123, tag CI::trsm).
     # The reference grid-transposes R11inv then trmms; here the transpose is
     # an argument flag and XLA plans the data motion.
@@ -373,6 +397,7 @@ def _recurse(
             a_view=(d0, d0, n1, n1),
             b_view=(off, off + n1, n1, n2),
             out=Rp, out_off=(d0, d0 + n1),
+            balance=_bal(n1),
         )
 
     # 3. Schur complement: A22' = A22 − R12ᵀR12 (cholinv.hpp:131-134, CI::tmu).
@@ -387,6 +412,7 @@ def _recurse(
             a_view=(d0, d0 + n1, n1, n2),
             c_view=(off + n1, off + n1, n2, n2),
             in_place=cfg.schur_in_place,
+            balance=_bal(n2),
         )
 
     # 4. recurse on the trailing window (cholinv.hpp:139-142).  In-place
@@ -408,6 +434,7 @@ def _recurse(
                 mode=cfg.mode,
                 a_view=(d0, d0, n1, n1),
                 b_view=(d0, d0 + n1, n1, n2),
+                balance=_bal(n1),
             )
             RIp = summa.trmm(
                 grid, RIp, T,
@@ -446,6 +473,13 @@ def factor(
     n = A.shape[0]
     if A.shape[0] != A.shape[1]:
         raise ValueError(f"cholinv needs a square matrix, got {A.shape}")
+    if cfg.balance not in ("block", "tile_cyclic"):
+        raise ValueError(f"unknown balance {cfg.balance!r}")
+    if cfg.balance == "tile_cyclic" and cfg.mode != "explicit":
+        # the balanced schedules exist only in the explicit schedule; a
+        # silent block fallback here would mis-attribute a whole
+        # load-balance experiment
+        raise ValueError("balance='tile_cyclic' requires mode='explicit'")
     p = padded_dim(n, cfg.base_case_dim)
     # SPD-safe pad: diag(A, I) factors to diag(R, I) without cross-talk.
     Ap = grid.pin(pad_embed_identity(A, n, p))

@@ -94,6 +94,34 @@ class TestFactor:
             blk = np.asarray(R)[sl, sl]
             np.testing.assert_allclose(blk @ Ri[sl, sl], np.eye(32), atol=1e-12)
 
+    def test_balanced_schedule_matches_block(self, grid2x2x1):
+        # balance='tile_cyclic' with a tiny threshold forces the balanced
+        # trmm/syrk schedules at every window — results must agree with the
+        # block schedule to reduction-order roundoff, and the recorder's
+        # max-per-process column must actually DROP
+        from capital_tpu.utils import tracing
+
+        g = grid2x2x1
+        A = jax.device_put(_spd(128), g.face_sharding())
+        block = CholinvConfig(base_case_dim=32, mode="explicit")
+        bal = CholinvConfig(
+            base_case_dim=32, mode="explicit",
+            balance="tile_cyclic", balance_min_window=32,
+        )
+        with tracing.Recorder() as rb:
+            Rb, RIb = jax.jit(lambda a: cholesky.factor(g, a, block))(A)
+        with tracing.Recorder() as rc:
+            Rc, RIc = jax.jit(lambda a: cholesky.factor(g, a, bal))(A)
+        np.testing.assert_allclose(np.asarray(Rc), np.asarray(Rb), atol=1e-12)
+        np.testing.assert_allclose(np.asarray(RIc), np.asarray(RIb), atol=1e-11)
+        assert residual.cholesky_residual(A, Rc) < 1e-14
+        # the balanced schedule's critical path is strictly below block's
+        # on the trsm phase (max-per-process view)
+        assert (
+            rc.stats["CI::trsm"].flops_max < rb.stats["CI::trsm"].flops_max
+        )
+        assert rc.stats["CI::tmu"].flops_max < rb.stats["CI::tmu"].flops_max
+
     @pytest.mark.parametrize("split", [1, 2])
     @pytest.mark.parametrize("mode", ["xla", "explicit"])
     def test_split_and_mode_knobs(self, grid2x2x2, split, mode):

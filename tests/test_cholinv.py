@@ -121,6 +121,23 @@ class TestFactor:
             rc.stats["CI::trsm"].flops_max < rb.stats["CI::trsm"].flops_max
         )
         assert rc.stats["CI::tmu"].flops_max < rb.stats["CI::tmu"].flops_max
+        # combined with the in-place Schur memory mode (the flagship's
+        # pairing at scale): same results again
+        both = CholinvConfig(
+            base_case_dim=32, mode="explicit",
+            balance="tile_cyclic", balance_min_window=32,
+            schur_in_place=True,
+        )
+        R2, RI2 = jax.jit(lambda a: cholesky.factor(g, a, both))(A)
+        np.testing.assert_allclose(np.asarray(R2), np.asarray(Rb), atol=1e-12)
+        np.testing.assert_allclose(np.asarray(RI2), np.asarray(RIb), atol=1e-11)
+        # invalid knob values raise instead of silently running block
+        with pytest.raises(ValueError, match="balance"):
+            cholesky.factor(g, A, CholinvConfig(balance="cyclic"))
+        with pytest.raises(ValueError, match="explicit"):
+            cholesky.factor(
+                g, A, CholinvConfig(balance="tile_cyclic", mode="xla")
+            )
 
     @pytest.mark.parametrize("split", [1, 2])
     @pytest.mark.parametrize("mode", ["xla", "explicit"])

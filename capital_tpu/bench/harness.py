@@ -54,6 +54,20 @@ def noise_band_seconds() -> float:
     return 0.05 if _jax.default_backend() == "tpu" else 0.002
 
 
+def _resolve_delta(
+    run, k: int, cap: int, repeats: int, noise: float
+) -> tuple[float, float, int]:
+    """The one escalate-until-the-delta-clears-the-noise-band loop shared by
+    every protocol (timed_loop, timed_oneshot x2): returns (per-iter
+    seconds, raw delta, final trip count).  Callers decide what an
+    unresolved result means."""
+    t, delta = paired_median_delta(run, k, repeats)
+    while k < cap and delta < noise:
+        k = min(cap, max(k * 2, int(3.0 * noise / max(t, 1e-9))))
+        t, delta = paired_median_delta(run, k, repeats)
+    return t, delta, k
+
+
 def paired_median_delta(run, k: int, nrep: int) -> tuple[float, float]:
     """(per-iteration seconds, raw delta): median over INTERLEAVED
     (base, full) wall pairs of `run(1)` vs `run(k+1)`.
@@ -182,14 +196,12 @@ def timed_loop(
     t, delta = paired_median_delta(run, iters, repeats + 2)
     # Escalate the trip count until the DELTA clears the noise band: a
     # positive but small delta is still mostly noise (a ~2ms step was
-    # observed reporting 13ms when the total delta sat at ~40ms).  Aim the
-    # loop at a >=3x-band delta.
+    # observed reporting 13ms when the total delta sat at ~40ms).
     noise = noise_band_seconds()
-    k = iters
-    while k < 4096 and delta < noise:
-        grow = int(3.0 * noise / t) if t > 0.0 else k * 8
-        k = min(4096, max(k * 2, grow))
-        t, delta = paired_median_delta(run, k, repeats)
+    if delta < noise:
+        t, delta, k = _resolve_delta(run, iters, 4096, repeats, noise)
+    else:
+        k = iters
     if t <= 0.0 or delta < noise:
         # never resolved: refuse to return a fake number (a silent floor
         # once let a noise artifact win an autotune sweep; a positive delta
@@ -201,6 +213,101 @@ def timed_loop(
             f"{noise:.0e}s dispatch-noise band)"
         )
     return t
+
+
+def timed_oneshot(
+    gen: Callable[[jnp.ndarray], jnp.ndarray],
+    step: Callable[[jnp.ndarray], jnp.ndarray],
+    iters: int = 3,
+    repeats: int = 8,
+    device_check: bool = False,
+) -> tuple[float, float, dict]:
+    """The one-shot protocol (bench.py's large-n flagship discipline, made
+    reusable): the operand is REGENERATED inside the loop each iteration by
+    `gen(i)` (a fused elementwise program of the loop index — no persistent
+    operand carry, so peak memory excludes it) and `step(a)` must return a
+    scalar coupling value riding ops XLA cannot narrow (pallas chains /
+    whole-input consumers — the caller asserts this, e.g.
+    qr.pallas_coupled).  A regen-only loop is measured separately and
+    subtracted; the subtracted time must clear the noise band on its own.
+    Returns (net seconds/iter, regen seconds/iter, extras) — extras carries
+    the drift-guard fields (device_ms, wall_ms_below_floor) when
+    device_check measures a device floor for the net time."""
+
+    def make_loop(consume):
+        @jax.jit
+        def loop(eps, k):
+            def body(i, c):
+                a = jax.lax.optimization_barrier(gen(i))
+                return c + eps * consume(a)
+
+            return jax.lax.fori_loop(0, k, body, jnp.float32(0.0))
+
+        return loop
+
+    full = make_loop(lambda a: step(a).astype(jnp.float32))
+    regen = make_loop(lambda a: a[0, 0].astype(jnp.float32))
+    eps = jnp.asarray(0.0, jnp.float32)
+
+    def run(loop, k):
+        t0 = time.perf_counter()
+        float(loop(eps, k))
+        return time.perf_counter() - t0
+
+    run(full, 1), run(full, 1)  # compile + settle
+    noise = noise_band_seconds()
+    t, delta, iters = _resolve_delta(
+        lambda k: run(full, k), iters, 512, repeats, noise
+    )
+    if t <= 0.0 or delta < noise:
+        raise MeasurementUnresolved(
+            f"one-shot full loop unresolved (delta {delta:.3e}s at {iters})"
+        )
+    run(regen, 1)
+    tr, dr, kr = _resolve_delta(
+        lambda k: run(regen, k), max(iters, 16), 4096, repeats, noise
+    )
+    if dr < noise:
+        raise MeasurementUnresolved(
+            f"one-shot regen loop unresolved (delta {dr:.3e}s at {kr})"
+        )
+    net = t - tr
+    if net <= 0.0 or net * iters < noise:
+        raise MeasurementUnresolved(
+            f"one-shot net time {net:.3e}s/iter inside the noise band"
+        )
+    if device_check:
+        # the drift guard for the one-shot protocol: the NET device floor
+        # is the paired-delta difference of the two loops' device-op
+        # totals (same discipline as the walls); a net wall below it is
+        # re-measured, then floored — mirrors drivers._timed
+        from capital_tpu.bench import trace as trace_mod
+
+        def dev_total(loop, k):
+            budget = trace_mod.device_budget(lambda: float(loop(eps, k)))
+            budget.pop("async (overlapped)", None)
+            return sum(budget.values()) / 1e3  # ms -> s
+
+        try:
+            dfull = dev_total(full, iters + 1) - dev_total(full, 1)
+            dregen = dev_total(regen, iters + 1) - dev_total(regen, 1)
+            dnet = max(0.0, (dfull - dregen) / iters)
+        except Exception:
+            dnet = 0.0
+        if dnet > 0.0:
+            tries = 0
+            while net < dnet and tries < 2:
+                t2, d2, _ = _resolve_delta(
+                    lambda k: run(full, k), iters, 512, repeats, noise
+                )
+                if d2 >= noise:
+                    net = t2 - tr
+                tries += 1
+            if net < dnet:
+                return dnet, tr, {"device_ms": round(dnet * 1e3, 3),
+                                  "wall_ms_below_floor": round(net * 1e3, 3)}
+            return net, tr, {"device_ms": round(dnet * 1e3, 3)}
+    return net, tr, {}
 
 
 def report(

@@ -53,12 +53,26 @@ def run(base: argparse.Namespace, scale: int = 1) -> list[dict]:
     go(f"recursive cholesky N=16384 2x2 grid ({d4} devices)", drivers.cholinv,
        n=max(512, 16384 // scale), devices=d4, c=1)
     d8 = 8 if ndev >= 8 else ndev
-    # the 2M x 1024 row is an 8-device configuration; keep per-device work
-    # constant when fewer are present (three Q-sized buffers at the full m
-    # need ~16.3GB — measured OOM on one 15.75GB v5e)
-    m8 = max(2048, 2**21 * d8 // 8 // scale)
+    # the 2M x 1024 row is the BASELINE 8-rank configuration; since round 3
+    # it runs at FULL m even on one chip — but ONLY when the driver's
+    # one-shot regen protocol can engage (single device, pallas-coupled
+    # shapes; the carry loop needs ~4 Q-sized buffers — measured "Used
+    # 16.01G of 15.75G").  Non-eligible configs (xla/explicit mode, scaled
+    # n without the g=2 split, 1 < devices < 8) keep the per-device-scaled
+    # m of rounds 1-2 rather than walking into the known OOM.
+    from capital_tpu.models import qr as _qr
+    from capital_tpu.parallel.topology import Grid as _Grid
+
+    n8 = max(128, 1024 // scale)
+    if d8 == 1:
+        g1 = _Grid.square(c=1, devices=jax.devices()[:1])
+        mode8 = drivers._resolve_mode(base.mode, g1)
+        full_ok = _qr.pallas_coupled(g1, n8, mode8)
+    else:
+        full_ok = d8 >= 8  # 8 devices shard the carry; odd counts scale
+    m8 = max(2048, (2**21 if full_ok else 2**21 * d8 // 8) // scale)
     go(f"cacqr2 2Mx1024 tree ({d8} devices, m={m8})", drivers.cacqr,
-       m=m8, n=max(128, 1024 // scale), devices=d8, variant=2)
+       m=m8, n=n8, devices=d8, variant=2)
     go("spd inverse via cholesky", drivers.spd_inverse,
        n=max(256, 4096 // scale))
     return out

@@ -14,6 +14,7 @@ All functions accept (possibly sharded) jax Arrays and return scalars.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 # Validation matmuls run at precision='highest' unconditionally: on TPU the
@@ -61,8 +62,46 @@ def qr_orthogonality(Q: jnp.ndarray) -> jnp.ndarray:
 
 def qr_residual(A: jnp.ndarray, Q: jnp.ndarray, R: jnp.ndarray) -> jnp.ndarray:
     """‖A − QR‖_F / ‖A‖_F — reference qr::validate::residual
-    (test/qr/validate.hpp:37-52)."""
-    return rel_fro(A - jnp.matmul(Q, R, precision=_PREC), A)
+    (test/qr/validate.hpp:37-52).  Computed at the f32-floor dtype so the
+    gate's own accumulation noise (a bf16 sum over m·n squares) cannot
+    mask or manufacture a failure — same arithmetic as the blocked form,
+    so the two gates agree for any m."""
+    ct = jnp.promote_types(A.dtype, jnp.float32)
+    err = A.astype(ct) - jnp.matmul(
+        Q.astype(ct), R.astype(ct), precision=_PREC
+    )
+    return rel_fro(err, A.astype(ct))
+
+
+def qr_residual_blocked(
+    A: jnp.ndarray, Q: jnp.ndarray, R: jnp.ndarray, block_rows: int = 65536
+) -> jnp.ndarray:
+    """qr_residual accumulated over row blocks with a lax.scan: O(block·n)
+    extra memory instead of several m x n f32 temporaries — the dense form
+    OOMs validating the 2M x 1024 BASELINE shape on one v5e (the
+    FACTORIZATION fits; the residual's f32 err/QR buffers did not).
+    Falls back to the dense form when block_rows does not tile m."""
+    m, n = A.shape
+    if m % block_rows or m == block_rows:
+        return qr_residual(A, Q, R)
+    ct = jnp.promote_types(A.dtype, jnp.float32)  # f32 floor, f64 kept
+    Rt = R.astype(ct)  # R as given, like the dense form (no silent triu)
+    Ab = A.reshape(m // block_rows, block_rows, n)
+    Qb = Q.reshape(m // block_rows, block_rows, n)
+
+    def step(carry, ab_qb):
+        ab, qb = ab_qb
+        ab = ab.astype(ct)
+        err = ab - jnp.matmul(qb.astype(ct), Rt, precision=_PREC)
+        return (
+            (carry[0] + jnp.sum(jnp.square(err)),
+             carry[1] + jnp.sum(jnp.square(ab))),
+            None,
+        )
+
+    zero = jnp.zeros((), ct)
+    (num, den), _ = jax.lax.scan(step, (zero, zero), (Ab, Qb))
+    return jnp.sqrt(num) / jnp.sqrt(den)
 
 
 def inverse_residual(A: jnp.ndarray, Ainv: jnp.ndarray) -> jnp.ndarray:

@@ -195,3 +195,19 @@ class TestSweep1DBlocked:
             lambda a: qr.factor(g, a, CacqrConfig(num_iter=2, regime="1d", mode="pallas"))
         )(A)
         assert float(residual.qr_orthogonality(Q)) < 1e-13
+
+
+def test_qr_residual_blocked_matches_dense():
+    """The row-blocked residual (memory-lean validation for the 2M x 1024
+    shape) must agree with the dense form."""
+    from capital_tpu.utils import residual
+
+    g1 = Grid.square(c=1, devices=jax.devices("cpu")[:1])
+    A = _tall(2048, 512).astype(jnp.float64)
+    Q, R = qr.factor(g1, A, CacqrConfig(num_iter=2, regime="1d"))
+    dense = float(residual.qr_residual(A, Q, R))
+    blocked = float(residual.qr_residual_blocked(A, Q, R, block_rows=256))
+    assert blocked == pytest.approx(dense, rel=1e-6)
+    # non-dividing block falls back to the dense form
+    fb = float(residual.qr_residual_blocked(A, Q, R, block_rows=1000))
+    assert fb == pytest.approx(dense, rel=1e-12)

@@ -111,3 +111,29 @@ def test_newton_reports_executed_iters():
     got_flops = rec["value"] * 1e12 * rec["seconds"]
     tol = 0.05 + 0.5e-5 / rec["seconds"]
     assert abs(got_flops - want_flops) / want_flops < tol
+
+
+def test_timed_oneshot_refuses_noise_floor():
+    """The one-shot protocol must REFUSE (MeasurementUnresolved) rather than
+    print a noise artifact when the step never clears the dispatch band —
+    the same no-fake-numbers contract as timed_loop."""
+    import jax.numpy as jnp
+    import pytest as _pytest
+
+    from capital_tpu.bench import harness
+
+    def gen(i):
+        return jnp.full((8, 8), 1.0, jnp.float32) * (1.0 + 0.0 * i)
+
+    def step(a):
+        return a[0, 0] * 2.0  # trivially below any noise band
+
+    with _pytest.raises(harness.MeasurementUnresolved):
+        harness.timed_oneshot(gen, step, iters=2, repeats=2)
+
+
+def test_hbm_bytes_sane():
+    """_hbm_bytes returns the runtime figure when available, else the
+    conservative fallback — either way a plausible per-chip capacity."""
+    v = drivers._hbm_bytes()
+    assert 4e9 <= v <= 1e12

@@ -65,8 +65,11 @@ def _own_times(line):
             if stack:
                 stack[-1][3] += fin[2]
             out.append((fin[1], own))
-        if stack and start + dur > stack[-1][0]:
-            # overlapping, not nested (async tails) — treat as sibling
+        while stack and start + dur > stack[-1][0]:
+            # overlapping, not nested (async tails) — close EVERY stacked
+            # ancestor the new event outlasts, not just the top, so a tail
+            # spanning several ancestors doesn't leave the deeper ones open
+            # to absorb the overlap into the wrong phase bucket
             fin = stack.pop()
             own = fin[2] - fin[3]
             if stack:
@@ -83,21 +86,23 @@ def _own_times(line):
 
 
 def _iter_xla_op_events(space):
-    """Yield (metadata, own_duration_ps, stat_metadata, is_async) for every
-    device XLA-op event.  The 'Async XLA Ops' line reports in-flight
-    occupancy of DMAs that overlap compute — kept separate (occupancy is
-    not additive with op own time)."""
+    """Yield (plane_name, metadata, own_duration_ps, stat_metadata, is_async)
+    for every device XLA-op event.  The 'Async XLA Ops' line reports
+    in-flight occupancy of DMAs that overlap compute — kept separate
+    (occupancy is not additive with op own time)."""
     for plane in space.planes:
         if "TPU" not in plane.name:
             continue
         for line in plane.lines:
             if line.name == "XLA Ops":
                 for mid, own_ps in _own_times(line):
-                    yield plane.event_metadata.get(mid), own_ps, plane.stat_metadata, False
+                    yield (plane.name, plane.event_metadata.get(mid), own_ps,
+                           plane.stat_metadata, False)
             elif line.name == "Async XLA Ops":
                 for ev in line.events:
                     md = plane.event_metadata.get(ev.metadata_id)
-                    yield md, ev.duration_ps, plane.stat_metadata, True
+                    yield (plane.name, md, ev.duration_ps,
+                           plane.stat_metadata, True)
 
 
 def _bucket(md, stat_metadata) -> str:
@@ -136,12 +141,19 @@ def _bucket(md, stat_metadata) -> str:
 
 def device_budget(run, trace_dir: str | None = None) -> dict[str, float]:
     """Trace `run()` (which must block on completion) and return
-    {bucket: device milliseconds} of XLA-op own time, plus an
-    'async (overlapped)' entry for DMA in-flight occupancy (informational —
-    overlaps compute, not additive)."""
+    {bucket: device milliseconds} of XLA-op own time for the
+    **critical-path device plane** (the plane with the largest total own
+    time), plus an 'async (overlapped)' entry for that plane's DMA
+    in-flight occupancy (informational — overlaps compute, not additive).
+
+    Per-plane selection matters: on an n-device run every device's own time
+    ~equals the wall, so summing planes would report ~n x the true
+    per-iteration floor and poison harness.device_ms_per_iter's
+    below-floor check (round-3 advisor finding).  Taking the max plane is
+    the device-side critical path — the same max-over-ranks convention the
+    reference's bench timing uses (bench/cholesky/cholinv.cpp:51-59)."""
     from tensorflow.tsl.profiler.protobuf import xplane_pb2
 
-    own = collections.defaultdict(float)
     with tempfile.TemporaryDirectory() as tmp:
         d = trace_dir or tmp
         with jax.profiler.trace(d):
@@ -149,16 +161,36 @@ def device_budget(run, trace_dir: str | None = None) -> dict[str, float]:
         paths = glob.glob(os.path.join(d, "**", "*.xplane.pb"), recursive=True)
         if not paths:
             raise RuntimeError(f"no xplane.pb under {d}")
+        spaces = []
         for p in paths:
             space = xplane_pb2.XSpace()
             with open(p, "rb") as f:
                 space.ParseFromString(f.read())
-            for md, dur_ps, stat_md, is_async in _iter_xla_op_events(space):
-                if md is None:
-                    continue
-                key = "async (overlapped)" if is_async else _bucket(md, stat_md)
-                own[key] += dur_ps * 1e-9  # ps -> ms
-    return dict(own)
+            spaces.append((p, space))
+    return _critical_plane_budget(spaces)
+
+
+def _critical_plane_budget(spaces) -> dict[str, float]:
+    """{bucket: ms} of the max-total device plane over [(tag, XSpace)]."""
+    per_plane: dict[str, dict[str, float]] = collections.defaultdict(
+        lambda: collections.defaultdict(float)
+    )
+    for tag, space in spaces:
+        for plane, md, dur_ps, stat_md, is_async in _iter_xla_op_events(space):
+            if md is None:
+                continue
+            key = "async (overlapped)" if is_async else _bucket(md, stat_md)
+            # key planes by (source, plane-name): one xplane.pb per host,
+            # one plane per local device
+            per_plane[f"{tag}::{plane}"][key] += dur_ps * 1e-9  # ps -> ms
+    if not per_plane:
+        return {}
+
+    def compute_total(buckets):
+        return sum(v for k, v in buckets.items() if k != "async (overlapped)")
+
+    crit = max(per_plane.values(), key=compute_total)
+    return dict(crit)
 
 
 def print_budget(budget: dict[str, float], iters: int, label: str) -> dict:

@@ -140,8 +140,25 @@ def _pick_cyclic_tile(grid: Grid, dim: int, override: int) -> int:
     (c==1 square faces with d>1, tile tiling the global dim)."""
     d = grid.dx
     tile = override
-    if tile == 0 and d > 1 and (dim // d) % 4 == 0:
-        tile = dim // d // 4
+    if tile == 0 and d > 1:
+        base = dim // d // 4
+        if dim // d >= 128:
+            # MXU granularity: the schedule's skipping premise is whole
+            # 128-aligned tiles, so the auto-pick must be a 128 multiple
+            # (ragged sub-128 row slices waste the MXU and misalign the
+            # cost model's granularity).  Search DOWN from ~4 tiles/device
+            # for one that tiles the dim, and require more tiles than
+            # devices — at nt == d the "cyclic" permutation is the
+            # identity: zero balancing but two priced row-shuffles.
+            t = max(base // 128 * 128, 128)
+            while t >= 128 and (dim % (d * t) or dim // t <= d):
+                t -= 128
+            if t >= 128:
+                tile = t
+        elif base > 0 and (dim // d) % 4 == 0:
+            # sub-MXU shapes (CPU-mesh tests, tiny problems): alignment is
+            # moot; keep the 4-tiles-per-device heuristic
+            tile = base
     ok = (
         grid.c == 1
         and grid.dx == grid.dy
@@ -711,6 +728,11 @@ def trmm(
     a_dims = (a_view[2], a_view[3]) if a_view is not None else A.shape
     b_dims = (b_view[2], b_view[3]) if b_view is not None else B.shape
     if mode == "pallas" and grid.num_devices == 1 and args.diag != "U":
+        if balance == "tile_cyclic":
+            # single-device kernels skip dead tiles directly; the balanced
+            # schedule does not apply — honor the fallback-with-a-note
+            # contract instead of silently dropping the request
+            tracing.note("trmm::tile_cyclic_fallback")
         flops, comm, ncoll = tracing.gemm_cost(
             grid, b_dims[0], b_dims[1], a_dims[0], jnp.result_type(A, B)
         )
@@ -820,6 +842,10 @@ def syrk(
     if in_place and (args.beta == 0.0 or C is None):
         raise ValueError("in_place syrk requires the accumulate operand C")
     if mode == "pallas" and grid.num_devices == 1:
+        if balance == "tile_cyclic":
+            # same contract as trmm's pallas branch: the kernel skips dead
+            # tiles itself, so the cyclic schedule is a no-op here — note it
+            tracing.note("syrk::tile_cyclic_fallback")
         # mode='pallas' honors args.uplo: only that triangle of the product
         # is computed; skipping the symmetric redundancy is where the ~1.65x
         # comes from.  beta*C accumulates INSIDE the kernel at flush time

@@ -407,6 +407,32 @@ class TestTileCyclicBalance:
             # volumetric work is conserved up to tile-boundary rounding
             assert cm == pytest.approx(bm, abs=1.0 / (2 * d))
 
+    def test_auto_tile_is_mxu_aligned(self):
+        """Auto-picked tiles must be 128 multiples once the local dim can
+        carry them (round-3 advisor: local rows 384 -> tile 96 produced
+        ragged sub-MXU row slices); sub-128 locals keep the small-shape
+        heuristic (alignment moot), and explicit overrides are honored."""
+        import types
+
+        g = types.SimpleNamespace(dx=2, dy=2, c=1, num_chunks=0, num_devices=4)
+        # dim 49152 / d 2: base 6144 is already a 128 multiple
+        assert summa._pick_cyclic_tile(g, 49152, 0) == 6144
+        # dim 768 / d 2: base 96 -> NOT eligible raw; falls to single-128
+        assert summa._pick_cyclic_tile(g, 768, 0) == 128
+        # dim 2560 / d 2: base 320 -> rounds down to 256
+        assert summa._pick_cyclic_tile(g, 2560, 0) == 256
+        # dim 4608 / d 2: 512 fails divisibility -> next 128-multiple 384
+        assert summa._pick_cyclic_tile(g, 4608, 0) == 384
+        # dim 2304 / d 2: 256 fails divisibility -> 128
+        assert summa._pick_cyclic_tile(g, 2304, 0) == 128
+        # dim 256 / d 2: tile 128 would mean nt == d (identity perm,
+        # phantom shuffle cost) -> ineligible
+        assert summa._pick_cyclic_tile(g, 256, 0) == 0
+        # sub-MXU local dim (tests): 64/2 = 32 -> heuristic tile 8
+        assert summa._pick_cyclic_tile(g, 64, 0) == 8
+        # explicit override passes through eligibility unchanged
+        assert summa._pick_cyclic_tile(g, 64, 16) == 16
+
     def test_syrk_tile_cyclic_matches_block(self, grid2x2x1):
         g = grid2x2x1
         A = jax.device_put(

@@ -39,6 +39,16 @@ class TestOwnTimes:
         own = trace._own_times(line)
         assert sum(t for _, t in own) == 1000
 
+    def test_overlap_closes_all_outlasted_ancestors(self):
+        # a[0,100] contains b[10,40] contains c[20,20]; async tail
+        # d[30,200] outlasts ALL of a/b/c — every stacked ancestor must be
+        # closed (round-3 advisor: a single pop left the deeper ancestors
+        # open, mis-attributing the overlap across phase buckets)
+        line = _line([(0, 100, 1), (10, 40, 2), (20, 20, 3), (30, 200, 4)])
+        own = dict(trace._own_times(line))
+        # c: own 20; b: 40 - 20 = 20; a: 100 - 40 = 60; d: 200
+        assert own == {1: 60, 2: 20, 3: 20, 4: 200}
+
 
 class TestBucket:
     def _md(self, name, display=""):
@@ -63,3 +73,55 @@ class TestBucket:
             == "custom-call"
         )
         assert trace._bucket(self._md("%add.1 = f32[8] add(%a, %b)"), {}) == "other"
+
+
+class TestCriticalPlane:
+    """device_budget must report the max-total device plane, not the sum
+    over planes (round-3 advisor HIGH finding: on an n-device run the
+    summed floor is ~n x the true per-iteration device time and flags
+    honest walls as below-floor)."""
+
+    def _space(self, plane_specs):
+        """plane_specs: {plane_name: [(off, dur, mid, op_name)]}"""
+        space = xplane_pb2.XSpace()
+        for pname, events in plane_specs.items():
+            plane = space.planes.add(name=pname)
+            line = plane.lines.add(name="XLA Ops")
+            for off, dur, mid, op in events:
+                line.events.add(offset_ps=off, duration_ps=dur, metadata_id=mid)
+                plane.event_metadata[mid].name = op
+        return space
+
+    def test_max_plane_not_sum(self):
+        ps = 1_000_000  # 1 us in ps -> 1e-3 ms
+        space = self._space({
+            "/device:TPU:0 (pid 1)": [(0, 3 * ps, 1, "%CI.tmu.1 = f(...)")],
+            "/device:TPU:1 (pid 2)": [(0, 5 * ps, 2, "%CI.trsm.1 = f(...)")],
+            "/device:TPU:2 (pid 3)": [(0, 4 * ps, 3, "%copy.1 = copy(...)")],
+        })
+        budget = trace._critical_plane_budget([("t", space)])
+        # the critical plane is TPU:1 (5 us) — its buckets alone, no sums
+        assert budget == {"CI::trsm": pytest.approx(5e-3)}
+
+    def test_non_tpu_planes_ignored(self):
+        ps = 1_000_000
+        space = self._space({
+            "/host:CPU (pid 9)": [(0, 100 * ps, 1, "%copy.9 = copy(...)")],
+            "/device:TPU:0 (pid 1)": [(0, 2 * ps, 2, "%CI.tmu.1 = f(...)")],
+        })
+        budget = trace._critical_plane_budget([("t", space)])
+        assert budget == {"CI::tmu": pytest.approx(2e-3)}
+
+    def test_single_plane_unchanged(self):
+        ps = 1_000_000
+        space = self._space({
+            "/device:TPU:0 (pid 1)": [
+                (0, 2 * ps, 1, "%CI.tmu.1 = f(...)"),
+                (2 * ps, 1 * ps, 2, "%copy.1 = copy(...)"),
+            ],
+        })
+        budget = trace._critical_plane_budget([("t", space)])
+        assert budget == {
+            "CI::tmu": pytest.approx(2e-3),
+            "copy": pytest.approx(1e-3),
+        }

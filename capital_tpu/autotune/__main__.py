@@ -42,6 +42,17 @@ def main(argv=None) -> None:
         "shapes over the devices (+ flat for cacqr), or explicit "
         "DXxDYxC tokens like 2x2x1 2x2x2 flat",
     )
+    p.add_argument(
+        "--layouts", type=int, nargs="+", default=None,
+        help="device-ordering layouts crossed with each --grids token "
+        "(reference topology.h:77-123)",
+    )
+    p.add_argument(
+        "--chunks", type=int, nargs="+", default=None,
+        help="num_chunks values crossed with each --grids token (the "
+        "reference Ibcast/Iallreduce pipeline; the planner prices q since "
+        "round 4)",
+    )
     p.add_argument("--devices", type=int, default=0)
     p.add_argument("--platform", default=None)
     p.add_argument("--host-devices", type=int, default=0)
@@ -71,19 +82,37 @@ def main(argv=None) -> None:
     dtype = jnp.dtype(args.dtype)
     space = {"bc_dims": tuple(args.bc)} if args.bc else {}
     if args.grids:
+        layouts = args.layouts or [0]
+        chunks = args.chunks or [0]
         if args.grids == ["auto"]:
-            space["grids"] = sweep.grid_space(
-                dev, include_flat=(args.alg == "cacqr")
-            )
+            base = sweep.grid_space(dev, include_flat=(args.alg == "cacqr"))
+            shapes = [
+                None if g.dy == 1 and g.c == 1 and g.dx == len(dev)
+                else (g.dx, g.dy, g.c)
+                for g in base
+            ]
         else:
-            gs = []
+            shapes = []
             for tok in args.grids:
                 if tok == "flat":
-                    gs.append(Grid.flat(devices=dev))
+                    shapes.append(None)
                     continue
-                dx, dy, c = (int(x) for x in tok.split("x"))
-                gs.append(Grid.rect(dx, dy, c, devices=dev[: dx * dy * c]))
-            space["grids"] = gs
+                shapes.append(tuple(int(x) for x in tok.split("x")))
+        gs = []
+        for shp in shapes:
+            if shp is None:
+                gs.append(Grid.flat(devices=dev))
+                continue
+            dx, dy, c = shp
+            for lo in layouts:
+                for q in chunks:
+                    gs.append(
+                        Grid.rect(
+                            dx, dy, c, devices=dev[: dx * dy * c],
+                            layout=lo, num_chunks=q,
+                        )
+                    )
+        space["grids"] = gs
     if args.alg == "cholinv":
         # these knobs exist only in the cholinv space (cacqr sweeps
         # variant x bc x regime)

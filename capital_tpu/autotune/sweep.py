@@ -291,7 +291,12 @@ def _with_grids(grids, base_grid):
 
 
 def _gid(grid: Grid) -> str:
-    return f"g{grid.dx}x{grid.dy}x{grid.c}"
+    tag = f"g{grid.dx}x{grid.dy}x{grid.c}"
+    if getattr(grid, "layout", 0):
+        tag += f"l{grid.layout}"
+    if getattr(grid, "num_chunks", 0):
+        tag += f"q{grid.num_chunks}"
+    return tag
 
 
 def cholinv_space(
@@ -405,6 +410,10 @@ def tune_cholinv(
                 peak_flops=peak,
                 itemsize=jnp.dtype(dtype).itemsize,
                 split=cdict["split"],
+                # the topology's chunking rides into the alpha term (q-fold
+                # collective launches) — without this every q ranked alike
+                # (round-4 review finding)
+                num_chunks=grid.num_chunks,
             )
             preds.append(float(out[0, 0]))
         order = sorted(range(len(configs)), key=preds.__getitem__)

@@ -459,6 +459,88 @@ def spd_inverse(args) -> dict:
     return rec
 
 
+def trsm(args) -> dict:
+    """Bench the finished distributed TRSM (models/trsm.py — the capability
+    the reference stubs at diaginvert.hpp:9).  Times side='L', uplo='L'
+    (the back-substitution shape cholinv/cacqr lean on); --validate smoke-
+    tests all four side/uplo combos plus the unit_diag (Diag::AblasUnit)
+    surface at the bench size."""
+    from capital_tpu.models import trsm as trsm_mod
+
+    grid = _grid(args)
+    mode = _resolve_mode(args.mode, grid)
+    dtype = jnp.dtype(args.dtype)
+
+    # same well-conditioned direct-at-dtype triangular operand as the
+    # rectri driver (kappa ~ 2, off-diagonal ~23% of the norm)
+    @jax.jit
+    def _make(key):
+        G = jax.random.normal(key, (args.n, args.n), dtype=jnp.float32)
+        L = jnp.tril(G, -1) / jnp.sqrt(
+            jnp.asarray(args.n, jnp.float32)
+        ) + 3.0 * jnp.eye(args.n, dtype=jnp.float32)
+        return L.astype(dtype)
+
+    L = jax.block_until_ready(_make(jax.random.key(0)))
+    nrhs = args.m if args.m != 65536 or args.n >= 65536 else args.n
+    B = jax.block_until_ready(
+        jax.random.normal(jax.random.key(1), (args.n, nrhs), dtype=dtype)
+    )
+    cfg = trsm_mod.TrsmConfig(
+        base_case_dim=args.bc, mode=mode, precision=_precision(args, dtype)
+    )
+
+    def step(b):
+        return trsm_mod.solve(grid, L, b, side="L", uplo="L", cfg=cfg)
+
+    t, extra = _timed(args, step, B)
+    # standard TRSM flop count: n² flops per right-hand side
+    flops = 1.0 * args.n**2 * nrhs
+    rec = harness.report(
+        "trsm_tflops", t, flops, dtype, n=args.n, nrhs=nrhs, grid=repr(grid),
+        bc=args.bc, mode=mode, **_knobs(args), **extra,
+    )
+    if args.validate:
+        tol = _tolerance(dtype)
+        Lf = L.astype(jnp.float32)
+        Uf = jnp.triu(Lf.T)  # upper operand for the 'U' combos
+        for side in ("L", "R"):
+            for uplo in ("L", "U"):
+                T = Lf if uplo == "L" else Uf
+                Bs = B if side == "L" else B.T
+                X = jax.jit(
+                    lambda b, T=T, side=side, uplo=uplo: trsm_mod.solve(
+                        grid, T.astype(dtype), b, side=side, uplo=uplo, cfg=cfg
+                    )
+                )(Bs)
+                Tt = jnp.tril(T) if uplo == "L" else jnp.triu(T)
+                got = (
+                    jnp.matmul(Tt, X.astype(jnp.float32))
+                    if side == "L"
+                    else jnp.matmul(X.astype(jnp.float32), Tt)
+                )
+                _gate(
+                    f"trsm_residual_{side}{uplo}",
+                    float(residual.rel_fro(got - Bs.astype(jnp.float32), Bs)),
+                    tol,
+                )
+        # Diag::AblasUnit parity: unit_diag result == solve against the
+        # explicit unit-diagonal operand
+        L1 = jnp.tril(Lf, -1) + jnp.eye(args.n, dtype=jnp.float32)
+        Xu = jax.jit(
+            lambda b: trsm_mod.solve(
+                grid, L.astype(dtype), b, side="L", uplo="L", unit_diag=True, cfg=cfg
+            )
+        )(B)
+        got = jnp.matmul(L1, Xu.astype(jnp.float32))
+        _gate(
+            "trsm_residual_unit_diag",
+            float(residual.rel_fro(got - B.astype(jnp.float32), B)),
+            tol,
+        )
+    return rec
+
+
 DRIVERS = {
     "cholinv": cholinv,
     "cacqr": cacqr,
@@ -466,6 +548,7 @@ DRIVERS = {
     "rectri": rectri,
     "newton": newton,
     "spd_inverse": spd_inverse,
+    "trsm": trsm,
 }
 
 

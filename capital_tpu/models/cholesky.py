@@ -283,10 +283,19 @@ def _scoped_base_factor(
     The cond guards only local compute, never a collective; the zero branch
     is pcast to the varying type the psum needs.
     """
-    if scope_ == "all" or grid.num_devices == 1 or (
-        scope_ == "layer" and grid.c == 1
-    ):
+    if grid.num_devices == 1:
         return lapack.potrf_trtri_upper(window)
+    if scope_ == "all" or (scope_ == "layer" and grid.c == 1):
+        # multi-device redundant factorization: the XLA spelling, not the
+        # Pallas-transpose one — Mosaic custom calls cannot be partitioned
+        # by GSPMD over a replicated multi-device panel (found by the
+        # round-4 AOT compile against a deviceless v5e-8 topology; the CPU
+        # mesh hid it because interpret-mode pallas lowers to plain HLO),
+        # and the layout-cascade rationale for the kernel is a single-chip
+        # flagship concern
+        from capital_tpu.ops import masking
+
+        return lapack.potrf_trtri(masking.symmetrize_from(window, "U"), uplo="U")
 
     axes = ("z",) if scope_ == "layer" else ("x", "y", "z")
 

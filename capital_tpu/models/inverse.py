@@ -47,6 +47,13 @@ class RectriConfig:
     base_case_dim: int = 256
     mode: str = "xla"
     precision: str | None = "highest"
+    balance: str = "block"  # 'tile_cyclic' routes the EXPLICIT-mode side-L
+    # merge trmm through the tile-cyclic balanced schedule for windows >=
+    # balance_min_window — same calculus as CholinvConfig.balance (the
+    # side-R product keeps blocks: the balanced form is side-L/syrk only).
+    # No effect outside explicit mode (single-device pallas kernels skip
+    # dead tiles natively).
+    balance_min_window: int = 8192
 
 
 def _rectri_into(
@@ -60,16 +67,19 @@ def _rectri_into(
     """Invert the lower-triangular window (off, off, size, size) of Tp into
     the same window of the flat buffer `out` (consumed; in-place on the
     pallas path)."""
+    from capital_tpu.utils import tracing
+
     if size <= cfg.base_case_dim:
-        window = lax.slice(Tp, (off, off), (off + size, off + size))
-        if grid.num_devices > 1:
-            window = lax.with_sharding_constraint(
-                window, grid.replicated_sharding()
+        with tracing.scope("RT::base"):
+            window = lax.slice(Tp, (off, off), (off + size, off + size))
+            if grid.num_devices > 1:
+                window = lax.with_sharding_constraint(
+                    window, grid.replicated_sharding()
+                )
+            inv = lapack.trtri(window, uplo="L")
+            return grid.pin(
+                lax.dynamic_update_slice(out, inv.astype(out.dtype), (off, off))
             )
-        inv = lapack.trtri(window, uplo="L")
-        return grid.pin(
-            lax.dynamic_update_slice(out, inv.astype(out.dtype), (off, off))
-        )
 
     n1 = size // 2
     n2 = size - n1
@@ -80,20 +90,31 @@ def _rectri_into(
     # buffers — the cholinv design (models/cholesky.py): no per-level
     # jnp.block assembly, and both trmms skip the triangular operand's dead
     # blocks (pallas single-device; segment-skipping explicit mode on a mesh)
+    bal = (
+        "tile_cyclic"
+        if (
+            cfg.balance == "tile_cyclic"
+            and cfg.mode == "explicit"
+            and n2 >= cfg.balance_min_window
+        )
+        else "block"
+    )
     targs = dict(mode=cfg.mode)
-    M = summa.trmm(
-        grid, out, Tp,
-        TrmmArgs(side="R", uplo="L", precision=cfg.precision), **targs,
-        a_view=(off, off, n1, n1),          # L11inv
-        b_view=(off + n1, off, n2, n1),     # L21
-    )
-    out = summa.trmm(
-        grid, out, M,
-        TrmmArgs(side="L", uplo="L", alpha=-1.0, precision=cfg.precision),
-        **targs,
-        a_view=(off + n1, off + n1, n2, n2),  # L22inv
-        out=out, out_off=(off + n1, off),
-    )
+    with tracing.scope("RT::merge"):
+        M = summa.trmm(
+            grid, out, Tp,
+            TrmmArgs(side="R", uplo="L", precision=cfg.precision), **targs,
+            a_view=(off, off, n1, n1),          # L11inv
+            b_view=(off + n1, off, n2, n1),     # L21
+        )
+        out = summa.trmm(
+            grid, out, M,
+            TrmmArgs(side="L", uplo="L", alpha=-1.0, precision=cfg.precision),
+            **targs,
+            a_view=(off + n1, off + n1, n2, n2),  # L22inv
+            out=out, out_off=(off + n1, off),
+            balance=bal,
+        )
     return out
 
 

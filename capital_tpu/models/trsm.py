@@ -43,10 +43,17 @@ class TrsmConfig:
 
 
 def _base_solve(
-    grid: Grid, T: jnp.ndarray, B: jnp.ndarray, lower: bool, left: bool
+    grid: Grid,
+    T: jnp.ndarray,
+    B: jnp.ndarray,
+    lower: bool,
+    left: bool,
+    unit_diag: bool,
 ) -> jnp.ndarray:
     Tr = lax.with_sharding_constraint(T, grid.replicated_sharding())
-    X = lax.linalg.triangular_solve(Tr, B, left_side=left, lower=lower)
+    X = lax.linalg.triangular_solve(
+        Tr, B, left_side=left, lower=lower, unit_diagonal=unit_diag
+    )
     return grid.pin(X)
 
 
@@ -58,11 +65,16 @@ def solve(
     uplo: str = "L",
     trans_a: bool = False,
     cfg: TrsmConfig = TrsmConfig(),
+    *,
+    unit_diag: bool = False,
 ) -> jnp.ndarray:
     """X with op(tri(A)) @ X = B (side='L') or X @ op(tri(A)) = B (side='R').
 
     The working replacement for trsm::diaginvert::solve
     (reference diaginvert.hpp:9).  jit-friendly; recursion is trace-time.
+    unit_diag treats tri(A)'s diagonal as ones without reading it — the
+    reference BLAS surface's Diag::AblasUnit (src/blas/engine.h:23-52),
+    honored here like summa.trmm's TrmmArgs.diag.
     """
     if side not in ("L", "R"):
         raise ValueError(f"side must be 'L' or 'R', got {side!r}")
@@ -80,7 +92,8 @@ def solve(
         # op(T) x = b  <=>  solve with the transposed triangle; fold the
         # transpose into the effective uplo and recurse untransposed.
         return solve(
-            grid, summa.transpose(grid, A), B, side, "U" if lower else "L", False, cfg
+            grid, summa.transpose(grid, A), B, side, "U" if lower else "L",
+            False, cfg, unit_diag=unit_diag,
         )
 
     # Distributed grids: pad A to bc·2^k at the boundary (diag(A, I) — stays
@@ -106,7 +119,7 @@ def solve(
     # design); the updated right-hand sides still flow down as values,
     # which is inherent to the substitution order.
     X = grid.pin(jnp.zeros_like(B))
-    X = _solve_into(grid, A, B, X, 0, p, side, lower, cfg)
+    X = _solve_into(grid, A, B, X, 0, p, side, lower, unit_diag, cfg)
     X = grid.pin(X)
     if p != n:
         X = X[:n, :] if side == "L" else X[:, :n]
@@ -122,6 +135,7 @@ def _solve_into(
     size: int,
     side: str,
     lower: bool,
+    unit_diag: bool,
     cfg: TrsmConfig,
 ) -> jnp.ndarray:
     """Solve the (off, off, size, size) window of tri(A) against the current
@@ -140,7 +154,11 @@ def _solve_into(
 
     if size <= cfg.base_case_dim:
         Tw = lax.slice(A, (off, off), (off + size, off + size))
-        return _put(X, _base_solve(grid, Tw, B, lower, left=(side == "L")), off)
+        return _put(
+            X,
+            _base_solve(grid, Tw, B, lower, left=(side == "L"), unit_diag=unit_diag),
+            off,
+        )
 
     n1 = size // 2
     n2 = size - n1
@@ -149,22 +167,22 @@ def _solve_into(
 
     if side == "L" and lower:
         A21 = lax.slice(A, (o2, o1), (o2 + n2, o1 + n1))
-        X = _solve_into(grid, A, B[:n1, :], X, o1, n1, side, lower, cfg)
+        X = _solve_into(grid, A, B[:n1, :], X, o1, n1, side, lower, unit_diag, cfg)
         B2 = summa.gemm(grid, A21, _xwin(o1, n1), B[n1:, :], gargs, mode=cfg.mode)
-        X = _solve_into(grid, A, B2, X, o2, n2, side, lower, cfg)
+        X = _solve_into(grid, A, B2, X, o2, n2, side, lower, unit_diag, cfg)
     elif side == "L" and not lower:
         A12 = lax.slice(A, (o1, o2), (o1 + n1, o2 + n2))
-        X = _solve_into(grid, A, B[n1:, :], X, o2, n2, side, lower, cfg)
+        X = _solve_into(grid, A, B[n1:, :], X, o2, n2, side, lower, unit_diag, cfg)
         B1 = summa.gemm(grid, A12, _xwin(o2, n2), B[:n1, :], gargs, mode=cfg.mode)
-        X = _solve_into(grid, A, B1, X, o1, n1, side, lower, cfg)
+        X = _solve_into(grid, A, B1, X, o1, n1, side, lower, unit_diag, cfg)
     elif side == "R" and lower:
         A21 = lax.slice(A, (o2, o1), (o2 + n2, o1 + n1))
-        X = _solve_into(grid, A, B[:, n1:], X, o2, n2, side, lower, cfg)
+        X = _solve_into(grid, A, B[:, n1:], X, o2, n2, side, lower, unit_diag, cfg)
         B1 = summa.gemm(grid, _xwin(o2, n2), A21, B[:, :n1], gargs, mode=cfg.mode)
-        X = _solve_into(grid, A, B1, X, o1, n1, side, lower, cfg)
+        X = _solve_into(grid, A, B1, X, o1, n1, side, lower, unit_diag, cfg)
     else:  # side == "R", upper
         A12 = lax.slice(A, (o1, o2), (o1 + n1, o2 + n2))
-        X = _solve_into(grid, A, B[:, :n1], X, o1, n1, side, lower, cfg)
+        X = _solve_into(grid, A, B[:, :n1], X, o1, n1, side, lower, unit_diag, cfg)
         B2 = summa.gemm(grid, _xwin(o1, n1), A12, B[:, n1:], gargs, mode=cfg.mode)
-        X = _solve_into(grid, A, B2, X, o2, n2, side, lower, cfg)
+        X = _solve_into(grid, A, B2, X, o2, n2, side, lower, unit_diag, cfg)
     return X

@@ -80,7 +80,7 @@ def _lib() -> ctypes.CDLL | None:
         lib.cholinv_predict.argtypes = [
             i64, i64, i64, i64,
             ctypes.c_double, ctypes.c_double, ctypes.c_double,
-            i64, i64p, i64, i32p, i64, i64, i32, dp,
+            i64, i64p, i64, i32p, i64, i64, i32, i64, dp,
         ]
         lib.cholinv_predict.restype = i64
         _LIB = lib
@@ -241,13 +241,18 @@ def cholinv_predict(
     itemsize: int = 2,
     split: int = 1,
     complete_inv: bool = True,
+    num_chunks: int = 0,
 ):
     """Predicted seconds per (policy, bc) config from the alpha-beta model;
     returns (seconds[num_pol, num_bc], (best_policy_idx, best_bc_idx)).
 
     The native predictive half of autotune: prune the measured sweep to the
     model's frontier before spending device time (the reference instead
-    measures every config, tune.cpp:239-253)."""
+    measures every config, tune.cpp:239-253).  num_chunks models the
+    reference's Ibcast/Iallreduce pipelining (summa.hpp:196-248): same
+    bytes, chunk-fold more collective launches — only the alpha term moves
+    (round-3 deliberately ignored chunks; a chunks-axis sweep would have
+    ranked every q identically)."""
     lib = _lib()
     bcs = np.asarray(list(bc_dims), dtype=np.int64)
     pols = np.asarray([int(getattr(p, "value", p)) for p in policies], dtype=np.int32)
@@ -256,7 +261,8 @@ def cholinv_predict(
     if lib is not None:
         best = lib.cholinv_predict(
             n, dx, dy, c, peak_flops, bw_bytes_per_s, alpha_s, itemsize,
-            bcs, len(bcs), pols, len(pols), split, int(complete_inv), out,
+            bcs, len(bcs), pols, len(pols), split, int(complete_inv),
+            num_chunks, out,
         )
         return out, (int(best) // len(bcs), int(best) % len(bcs))
     # NumPy fallback: same model (kept in lock-step with the C++ by
@@ -265,13 +271,16 @@ def cholinv_predict(
         for ib, bc in enumerate(bcs):
             out[ip, ib] = _predict_py(
                 n, dx, dy, c, peak_flops, bw_bytes_per_s, alpha_s, itemsize,
-                int(bc), int(pol), split, complete_inv,
+                int(bc), int(pol), split, complete_inv, num_chunks,
             )
     best = int(np.argmin(out))
     return out, (best // len(bcs), best % len(bcs))
 
 
-def _predict_py(n, dx, dy, c, peak, bw, alpha, item, bc, pol, split, complete_inv):
+def _predict_py(
+    n, dx, dy, c, peak, bw, alpha, item, bc, pol, split, complete_inv,
+    num_chunks=0,
+):
     def ring(b, p):
         return b * (p - 1) / p if p > 1 else 0.0
 
@@ -279,11 +288,9 @@ def _predict_py(n, dx, dy, c, peak, bw, alpha, item, bc, pol, split, complete_in
         return 2.0 * b * (p - 1) / p if p > 1 else 0.0
 
     def gemm(M, N, K, tri=0.5):
-        # mirrors tracing.gemm_cost at num_chunks=1: c==1 amortized ring
-        # all_gathers; c>1 per-step masked-psum broadcasts of the layer's
-        # d/c panels.  The chunking knob is deliberately NOT modeled here
-        # (same bytes, q-scaled collective counts): the planner prefilters
-        # configs, and config spaces do not sweep chunks.
+        # mirrors tracing.gemm_cost: c==1 amortized ring all_gathers; c>1
+        # per-step masked-psum broadcasts of the layer's d/c panels.
+        # num_chunks: same bytes, q-fold collective launches (alpha term).
         p = dx * dy * c
         d = max(dx, dy)
         fl = tri * 2.0 * M * N * K / p
@@ -299,6 +306,8 @@ def _predict_py(n, dx, dy, c, peak, bw, alpha, item, bc, pol, split, complete_in
             nc = steps * ((1.0 if dy > 1 else 0.0) + (1.0 if dx > 1 else 0.0))
         comm += allred(M / dx * N / dy * item, c)
         nc += 1.0 if c > 1 else 0.0
+        if num_chunks > 1:
+            nc *= num_chunks
         return fl, comm, nc
 
     p = dx * dy * c

@@ -168,7 +168,8 @@ static inline double allreduce_bytes(double bytes, int64_t p) {
 // (parallel/summa.py:_explicit_matmul): c == 1 amortized ring all_gathers;
 // c > 1 per-step masked-psum broadcasts of the layer's d/c panels.
 static Cost gemm_cost(int64_t M, int64_t N, int64_t K, int64_t dx, int64_t dy,
-                      int64_t c, int64_t item, double tri_frac) {
+                      int64_t c, int64_t item, double tri_frac,
+                      int64_t num_chunks) {
   const int64_t p = dx * dy * c;
   const int64_t d = std::max(dx, dy);
   Cost r;
@@ -188,6 +189,11 @@ static Cost gemm_cost(int64_t M, int64_t N, int64_t K, int64_t dx, int64_t dy,
   }
   r.comm += allreduce_bytes(c_blk, c);
   r.ncoll += c > 1 ? 1.0 : 0.0;
+  // num_chunks pipelining (the reference's Ibcast/Iallreduce slices,
+  // summa.hpp:196-248): same bytes, q-fold more collective launches --
+  // the alpha term is where chunking costs (and where overlap pays; the
+  // model prices the launches, XLA's scheduler owns the overlap)
+  if (num_chunks > 1) r.ncoll *= (double)num_chunks;
   return r;
 }
 
@@ -198,7 +204,7 @@ static void add(Cost* acc, Cost c) {
 // Recursion over the window; mirrors plan()/_recurse() phase structure.
 static void cholinv_walk(int64_t w, int64_t bc, int64_t split, int64_t dx,
                          int64_t dy, int64_t c, int64_t item, int32_t policy,
-                         int32_t complete_inv, Cost* acc) {
+                         int32_t complete_inv, int64_t num_chunks, Cost* acc) {
   const int64_t p = dx * dy * c;
   if (w <= bc) {
     // base case (models/cholesky.py:_base_case_into): the panel is
@@ -223,15 +229,15 @@ static void cholinv_walk(int64_t w, int64_t bc, int64_t split, int64_t dx,
   }
   int64_t n1 = std::max(bc, w >> split);
   int64_t m2 = w - n1;
-  cholinv_walk(n1, bc, split, dx, dy, c, item, policy, 1, acc);
+  cholinv_walk(n1, bc, split, dx, dy, c, item, policy, 1, num_chunks, acc);
   // TRSM phase: R12 = R11^-T A12 (trmm, triangular operand halves the flops)
-  add(acc, gemm_cost(n1, m2, n1, dx, dy, c, item, 0.5));
+  add(acc, gemm_cost(n1, m2, n1, dx, dy, c, item, 0.5, num_chunks));
   // Schur: A22 -= R12^T R12 (syrk: symmetric output halves useful flops)
-  add(acc, gemm_cost(m2, m2, n1, dx, dy, c, item, 0.5));
-  cholinv_walk(m2, bc, split, dx, dy, c, item, policy, 1, acc);
+  add(acc, gemm_cost(m2, m2, n1, dx, dy, c, item, 0.5, num_chunks));
+  cholinv_walk(m2, bc, split, dx, dy, c, item, policy, 1, num_chunks, acc);
   if (complete_inv) {  // inverse completion: two trmms
-    add(acc, gemm_cost(n1, m2, n1, dx, dy, c, item, 0.5));
-    add(acc, gemm_cost(n1, m2, m2, dx, dy, c, item, 0.5));
+    add(acc, gemm_cost(n1, m2, n1, dx, dy, c, item, 0.5, num_chunks));
+    add(acc, gemm_cost(n1, m2, m2, dx, dy, c, item, 0.5, num_chunks));
   }
 }
 
@@ -242,7 +248,7 @@ int64_t cholinv_predict(int64_t n, int64_t dx, int64_t dy, int64_t c,
                         int64_t itemsize, const int64_t* bcs, int64_t num_bc,
                         const int32_t* policies, int64_t num_pol,
                         int64_t split, int32_t complete_inv,
-                        double* out_seconds) {
+                        int64_t num_chunks, double* out_seconds) {
   int64_t best = 0;
   for (int64_t ip = 0; ip < num_pol; ++ip) {
     for (int64_t ib = 0; ib < num_bc; ++ib) {
@@ -251,7 +257,7 @@ int64_t cholinv_predict(int64_t n, int64_t dx, int64_t dy, int64_t c,
       while (padded < n) padded *= 2;
       Cost acc{0, 0, 0};
       cholinv_walk(padded, bc, split, dx, dy, c, itemsize, policies[ip],
-                   complete_inv, &acc);
+                   complete_inv, num_chunks, &acc);
       double s = acc.flops / peak_flops + acc.comm / bw_Bps + acc.ncoll * alpha_s;
       out_seconds[ip * num_bc + ib] = s;
       if (s < out_seconds[best]) best = ip * num_bc + ib;
@@ -260,6 +266,6 @@ int64_t cholinv_predict(int64_t n, int64_t dx, int64_t dy, int64_t c,
   return best;
 }
 
-int32_t capital_native_abi_version(void) { return 1; }
+int32_t capital_native_abi_version(void) { return 2; }
 
 }  // extern "C"

@@ -15,25 +15,31 @@ wrote it.  These kernels remove both redundancies (VERDICT r2 #3 — the
 "fused gram+scaling kernel" docs/PERF.md names as the remaining lever):
 
 * ``gram_blocked`` — one pass over A per gram: each (bm, n) row block is
-  read ONCE into VMEM and both upper block-row products are taken from it
-  (G[:nb, :] += A_blkᵀ[:, :nb]·A_blk and G[nb:, nb:] += the trailing
-  square), accumulating into a VMEM-resident f32 (n, n) output revisited
-  by every grid step.  HBM traffic: m·n reads exactly (was 1.5 m·n).
+  read ONCE into VMEM and the g upper block-row products are taken from it
+  (G[jc:(j+1)c, jc:] += A_blk[:, jc:(j+1)c]ᵀ·A_blk[:, jc:]), accumulating
+  into a VMEM-resident f32 (n, n) output revisited by every grid step.
+  HBM traffic: m·n reads exactly (was 1.5 m·n).
 * ``scale_gram`` — sweep 1's scale and sweep 2's gram in ONE pass: read a
-  row block of A, Q_blk = A_blk·R⁻¹ via two column-block products (the
-  zero lower blocks of the upper-triangular R⁻¹ are never touched: 3/4 of
-  dense flops), round Q_blk to the output dtype, write it, and accumulate
-  G2 += Q_blkᵀQ_blk (upper block-rows) from the registers — sweep 2's
-  gram costs ZERO extra HBM traffic (was a full m·n read of Q1).
+  row block of A, Q_blk = A_blk·R⁻¹ via g column-block products (the
+  zero lower blocks of the upper-triangular R⁻¹ are never touched:
+  (g+1)/2g of dense flops), round Q_blk to the output dtype, write it, and
+  accumulate G2 += Q_blkᵀQ_blk (upper block-rows) from the registers —
+  sweep 2's gram costs ZERO extra HBM traffic (was a full m·n read of Q1).
 
-Both kernels require the g=2 column split (n/2 a 128-multiple — the only
-split that wins, models/qr.py:_col_blocks) and bm | m; callers fall back
-to the unfused path otherwise.  The gram accumulates over row blocks in
-f32 (same reduction values as the unfused blocked gram, different
-association order: bitwise parity is NOT guaranteed, agreement is to
-roundoff — tests/test_qr_fused.py).  The gram is taken from the ROUNDED
-Q_blk, exactly like the unfused pipeline which re-reads the written bf16
-Q1, so fused/unfused see the same operand.
+The column split ``g`` is an IN-KERNEL knob (round-4, VERDICT r3 #1): all
+operands of every sub-product are already VMEM-resident, so finer splits
+reduce executed flops — (g+1)/2g of dense: 0.75 at g=2, 0.625 at g=4,
+0.5625 at g=8 — at zero extra HBM traffic, unlike the measured XLA-level
+g=4 loser (5x A reads + relayout copies, models/qr.py:_col_blocks).  The
+per-dot shapes stay MXU-aligned (every block dim a 128-multiple >= 128).
+
+Kernels require n % (g*128) == 0 and bm | m; callers fall back to the
+unfused path otherwise.  The gram accumulates over row blocks in f32 (same
+reduction values as the unfused blocked gram, different association order:
+bitwise parity is NOT guaranteed, agreement is to roundoff —
+tests/test_qr_fused.py).  The gram is taken from the ROUNDED Q_blk, exactly
+like the unfused pipeline which re-reads the written bf16 Q1, so
+fused/unfused see the same operand.
 """
 
 from __future__ import annotations
@@ -70,42 +76,66 @@ def _pick_bm(m: int, preferred: int) -> int:
     return bm if m % bm == 0 else 0
 
 
-def _eligible(m: int, n: int, bm: int = 1024) -> int:
+def live_fraction(g: int) -> float:
+    """Executed fraction of the dense contraction at column split g."""
+    return (g + 1) / (2.0 * g) if g > 1 else 1.0
+
+
+def _eligible(m: int, n: int, bm: int = 1024, g: int = 2) -> int:
     """The ONE eligibility rule for every fused tall-pass kernel (and for
-    fused_ok): g=2 column split (n % 256 == 0, n/2 a 128-multiple of at
-    least 256 — the only split that wins, models/qr.py:_col_blocks) and a
-    row block that tiles m.  Returns the picked bm, or 0 if ineligible."""
-    if n % 256 or (n // 2) % 128 or n // 2 < 256:
+    fused_ok): the g-way column split needs every block a 128-multiple of
+    at least 128 (g=2 additionally demands n/2 >= 256 — at n = 512 the
+    split's saving measured below its bookkeeping) and a row block that
+    tiles m.  Returns the picked bm, or 0 if ineligible."""
+    if g < 2 or n % (g * 128):
+        return 0
+    if g == 2 and n // 2 < 256:
         return 0
     return _pick_bm(m, bm)
 
 
-def _shape_gate(name: str, m: int, n: int, bm: int) -> int:
-    bm = _eligible(m, n, bm)
+def _shape_gate(name: str, m: int, n: int, bm: int, g: int) -> int:
+    bm = _eligible(m, n, bm, g)
     if bm == 0:
         raise ValueError(
-            f"{name} needs bm | m and the g=2 split (n % 256 == 0, "
-            f"n/2 >= 256), got {(m, n)}"
+            f"{name} needs bm | m and a {g}-way 128-aligned column split "
+            f"(n % {g * 128} == 0), got {(m, n)}"
         )
     return bm
+
+
+def pick_g(n: int, override: int = 0) -> int:
+    """Column-split auto-pick for the fused kernels.  Measured on v5e at
+    1M x 1024 bf16 (docs/PERF.md round-4 table): executed flops drop with g
+    ((g+1)/2g) while the per-dot MXU shapes shrink; g=8 (128-wide blocks)
+    was the measured winner, g=16 ineligible at n=1024.  Larger n keeps
+    128-wide blocks eligible at larger g; cap at 8 where the measured
+    curve flattened."""
+    if override:
+        return override if _eligible(1 << 20, n, 1024, override) else 0
+    for g in (8, 4, 2):
+        if _eligible(1 << 20, n, 1024, g):
+            return g
+    return 0
 
 
 def gram_blocked(
     A: jnp.ndarray,
     *,
     bm: int = 1024,
+    g: int = 2,
     precision: str | None = None,
     interpret: bool | None = None,
 ) -> jnp.ndarray:
-    """Upper-block-row gram of tall-skinny A at the g=2 split: returns f32
-    (n, n) with rows [:nb] full and the [nb:, nb:] trailing square valid
-    (the strictly-lower [nb:, :nb] block is zero — callers assemble the
-    symmetric gram with one small transpose).  One HBM read of A total."""
+    """Upper-block-row gram of tall-skinny A at the g-way split: returns
+    f32 (n, n) with block row j valid from column j·(n/g) (the strictly
+    lower block triangle is zero — callers assemble the symmetric gram
+    with assemble_sym).  One HBM read of A total."""
     if interpret is None:
         interpret = _interpret_default()
     m, n = A.shape
-    nb = n // 2
-    bm = _shape_gate("gram_blocked", m, n, bm)
+    c = n // g
+    bm = _shape_gate("gram_blocked", m, n, bm, g)
     nsteps = m // bm
     acc = _acc_dtype(A.dtype)
 
@@ -117,10 +147,11 @@ def gram_blocked(
         def _():
             g_ref[:] = jnp.zeros_like(g_ref)
 
-        g_ref[0:nb, :] += _dot(a[:, 0:nb], a, acc, trans_a=True, precision=precision)
-        g_ref[nb:, nb:] += _dot(
-            a[:, nb:], a[:, nb:], acc, trans_a=True, precision=precision
-        )
+        for j in range(g):
+            g_ref[j * c:(j + 1) * c, j * c:] += _dot(
+                a[:, j * c:(j + 1) * c], a[:, j * c:], acc,
+                trans_a=True, precision=precision,
+            )
 
     return pl.pallas_call(
         kernel,
@@ -135,7 +166,7 @@ def gram_blocked(
             vmem_limit_bytes=_device_budget()[1],
         ),
         cost_estimate=pl.CostEstimate(
-            flops=2 * m * n * n * 3 // 4,
+            flops=int(2 * m * n * n * live_fraction(g)),
             bytes_accessed=m * n * jnp.dtype(A.dtype).itemsize + 4 * n * n,
             transcendentals=0,
         ),
@@ -148,6 +179,7 @@ def scale_gram(
     Rinv: jnp.ndarray,
     *,
     bm: int = 1024,
+    g: int = 2,
     precision: str | None = None,
     interpret: bool | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
@@ -163,20 +195,29 @@ def scale_gram(
     m, n = A.shape
     if Rinv.shape != (n, n):
         raise ValueError(f"Rinv {Rinv.shape} does not match A {A.shape}")
-    nb = n // 2
-    bm = _shape_gate("scale_gram", m, n, bm)
+    c = n // g
+    bm = _shape_gate("scale_gram", m, n, bm, g)
     nsteps = m // bm
     acc = _acc_dtype(A.dtype)
 
     def kernel(a_ref, r_ref, q_ref, g_ref):
         i = pl.program_id(0)
         a = a_ref[:]
-        # Q = A @ Rinv with the g=2 structure: the lower-left (nb, nb)
-        # block of upper-triangular Rinv is zero, so the head columns see
-        # only A's head columns — 3/4 of the dense flops, no masking
-        q_head = _dot(a[:, 0:nb], r_ref[0:nb, 0:nb], acc, precision=precision)
-        q_tail = _dot(a, r_ref[:, nb:], acc, precision=precision)
-        q = jnp.concatenate([q_head, q_tail], axis=1).astype(q_ref.dtype)
+        # Q = A @ Rinv with the g-way structure: column block j of
+        # upper-triangular Rinv has zeros below row (j+1)c, so it sees
+        # only A's leading (j+1)c columns — (g+1)/2g of dense flops,
+        # no masking
+        q = jnp.concatenate(
+            [
+                _dot(
+                    a[:, : (j + 1) * c],
+                    r_ref[0:(j + 1) * c, j * c:(j + 1) * c],
+                    acc, precision=precision,
+                )
+                for j in range(g)
+            ],
+            axis=1,
+        ).astype(q_ref.dtype)
         q_ref[:] = q
 
         @pl.when(i == 0)
@@ -184,10 +225,11 @@ def scale_gram(
             g_ref[:] = jnp.zeros_like(g_ref)
 
         # sweep-2 gram from the rounded block, straight from registers
-        g_ref[0:nb, :] += _dot(q[:, 0:nb], q, acc, trans_a=True, precision=precision)
-        g_ref[nb:, nb:] += _dot(
-            q[:, nb:], q[:, nb:], acc, trans_a=True, precision=precision
-        )
+        for j in range(g):
+            g_ref[j * c:(j + 1) * c, j * c:] += _dot(
+                q[:, j * c:(j + 1) * c], q[:, j * c:], acc,
+                trans_a=True, precision=precision,
+            )
 
     Q, G = pl.pallas_call(
         kernel,
@@ -209,7 +251,7 @@ def scale_gram(
             vmem_limit_bytes=_device_budget()[1],
         ),
         cost_estimate=pl.CostEstimate(
-            flops=2 * m * n * n * 3 // 2,  # 3/4 scale + 3/4 gram
+            flops=int(2 * m * n * n * 2 * live_fraction(g)),  # scale + gram
             bytes_accessed=2 * m * n * jnp.dtype(A.dtype).itemsize + 4 * n * n,
             transcendentals=0,
         ),
@@ -223,29 +265,38 @@ def scale_blocked(
     Rinv: jnp.ndarray,
     *,
     bm: int = 1024,
+    g: int = 2,
     precision: str | None = None,
     interpret: bool | None = None,
 ) -> jnp.ndarray:
-    """Q = A @ Rinv (upper-triangular Rinv with true zeros below, g=2
+    """Q = A @ Rinv (upper-triangular Rinv with true zeros below, g-way
     structure) — the scale half of scale_gram without the gram.  Used for
-    CQR2's FINAL scale: same two-dot column-block structure that measures
-    191 TF/s executed on v5e, vs 153 for the live-tile trmm kernel at
-    (1024, 512, 512) blocks on the same math (the trmm kernel pays
+    CQR2's FINAL scale: same column-block-dot structure that measures
+    191 TF/s executed on v5e at g=2, vs 153 for the live-tile trmm kernel
+    at (1024, 512, 512) blocks on the same math (the trmm kernel pays
     per-pair bookkeeping and a bk=512 K-split; this shape needs neither)."""
     if interpret is None:
         interpret = _interpret_default()
     m, n = A.shape
     if Rinv.shape != (n, n):
         raise ValueError(f"Rinv {Rinv.shape} does not match A {A.shape}")
-    nb = n // 2
-    bm = _shape_gate("scale_blocked", m, n, bm)
+    c = n // g
+    bm = _shape_gate("scale_blocked", m, n, bm, g)
     acc = _acc_dtype(A.dtype)
 
     def kernel(a_ref, r_ref, q_ref):
         a = a_ref[:]
-        q_head = _dot(a[:, 0:nb], r_ref[0:nb, 0:nb], acc, precision=precision)
-        q_tail = _dot(a, r_ref[:, nb:], acc, precision=precision)
-        q_ref[:] = jnp.concatenate([q_head, q_tail], axis=1).astype(q_ref.dtype)
+        q_ref[:] = jnp.concatenate(
+            [
+                _dot(
+                    a[:, : (j + 1) * c],
+                    r_ref[0:(j + 1) * c, j * c:(j + 1) * c],
+                    acc, precision=precision,
+                )
+                for j in range(g)
+            ],
+            axis=1,
+        ).astype(q_ref.dtype)
 
     return pl.pallas_call(
         kernel,
@@ -261,7 +312,7 @@ def scale_blocked(
             vmem_limit_bytes=_device_budget()[1],
         ),
         cost_estimate=pl.CostEstimate(
-            flops=2 * m * n * n * 3 // 4,
+            flops=int(2 * m * n * n * live_fraction(g)),
             bytes_accessed=2 * m * n * jnp.dtype(A.dtype).itemsize,
             transcendentals=0,
         ),
@@ -269,18 +320,21 @@ def scale_blocked(
     )(A, Rinv)
 
 
-def assemble_sym(Gu: jnp.ndarray, nb: int) -> jnp.ndarray:
-    """Symmetric gram from the upper-block-row form (lower-left block is
-    the transpose of the upper-right) — n² elementwise, negligible next to
-    the tall passes."""
-    return Gu.at[nb:, :nb].set(Gu[:nb, nb:].T)
+def assemble_sym(Gu: jnp.ndarray, c: int) -> jnp.ndarray:
+    """Symmetric gram from the upper-block-row form with block width c
+    (every strictly lower block is the transpose of its mirror) — n²
+    elementwise, negligible next to the tall passes."""
+    n = Gu.shape[0]
+    for i in range(1, n // c):
+        Gu = Gu.at[i * c:(i + 1) * c, : i * c].set(Gu[: i * c, i * c:(i + 1) * c].T)
+    return Gu
 
 
-def fused_ok(grid, m: int, n: int, mode: str, bm: int = 1024) -> bool:
+def fused_ok(grid, m: int, n: int, mode: str, bm: int = 1024, g: int = 2) -> bool:
     """Can the fused CQR2 pipeline run?  Single-device pallas mode plus the
     shared kernel eligibility rule (_eligible)."""
     return (
         mode == "pallas"
         and grid.num_devices == 1
-        and _eligible(m, n, bm) != 0
+        and _eligible(m, n, bm, g) != 0
     )

@@ -410,11 +410,35 @@ def _explicit_matmul(
     def _seg_live_b(yi, s, ch):
         return _seg_live_b_global(yi, s, ch, nb, lk, w, b_uplo)
 
+    solo = getattr(grid, "collective_concurrency", "free") == "solo"
+
     def kernel(a, b):
         # a: (M/d, K/d) block at (x, y);  b: (K/d, N/d) block at (x, y)
         xi = lax.axis_index("x")
         yi = lax.axis_index("y")
         zi = lax.axis_index("z")
+
+        # collective_concurrency='solo' (Grid knob — the reference's
+        # COLLECTIVE_CONCURRENCY_SOLO congestion experiment,
+        # summa.hpp:179-192): chain every collective behind the previous
+        # one with an optimization_barrier data dependency, so at most one
+        # is in flight.  `chain` threads a token value through each
+        # collective's INPUT; 'free' mode is the identity.
+        token = [None]
+
+        def chain(x):
+            if not solo:
+                return x
+            if token[0] is not None:
+                x, _ = lax.optimization_barrier((x, token[0]))
+            return x
+
+        def stamp(res):
+            if solo:
+                # tie the token to one element (cheap; keeps the barrier
+                # operand small and the dependency real)
+                token[0] = lax.slice(res.reshape(-1), (0,), (1,))
+            return res
 
         # every liveness test guards ONLY local matmuls, never a collective:
         # the gathers run unconditionally on all devices (a collective under
@@ -459,12 +483,12 @@ def _explicit_matmul(
                 # K-range [s*lk + ch*w, +w), contributed by device s of the
                 # gather axis; A's and B's segment decompositions of K match
                 # because the face is square
-                a_ch = lax.all_gather(
-                    a[:, ch * w : (ch + 1) * w], "y", axis=1, tiled=True
-                )
-                b_ch = lax.all_gather(
-                    b[ch * w : (ch + 1) * w, :], "x", axis=0, tiled=True
-                )
+                a_ch = stamp(lax.all_gather(
+                    chain(a[:, ch * w : (ch + 1) * w]), "y", axis=1, tiled=True
+                ))
+                b_ch = stamp(lax.all_gather(
+                    chain(b[ch * w : (ch + 1) * w, :]), "x", axis=0, tiled=True
+                ))
                 if cyclic_out:
                     # balanced tri-output skipping: per LOCAL OUTPUT TILE
                     # PAIR — original tile pair (gi, gj) is live iff it
@@ -559,12 +583,12 @@ def _explicit_matmul(
                 for ch in range(q):
                     a_sl = a[:, ch * w : (ch + 1) * w]
                     b_sl = b[ch * w : (ch + 1) * w, :]
-                    a_panel = lax.psum(
-                        jnp.where(yi == k, a_sl, jnp.zeros_like(a_sl)), "y"
-                    )
-                    b_panel = lax.psum(
-                        jnp.where(xi == k, b_sl, jnp.zeros_like(b_sl)), "x"
-                    )
+                    a_panel = stamp(lax.psum(
+                        chain(jnp.where(yi == k, a_sl, jnp.zeros_like(a_sl))), "y"
+                    ))
+                    b_panel = stamp(lax.psum(
+                        chain(jnp.where(xi == k, b_sl, jnp.zeros_like(b_sl))), "x"
+                    ))
                     live = None
                     if a_uplo is not None:
                         live = _seg_live_a(xi, k, ch)
@@ -591,7 +615,7 @@ def _explicit_matmul(
         pieces, off = [], 0
         for wd in widths:
             if wd:
-                pieces.append(lax.psum(part[:, off : off + wd], "z"))
+                pieces.append(stamp(lax.psum(chain(part[:, off : off + wd]), "z")))
                 off += wd
         return pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces, axis=1)
 

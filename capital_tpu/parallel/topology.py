@@ -140,10 +140,28 @@ class Grid:
             many slices so the compiler can overlap each slice's collective
             with the previous slice's local matmul (the Ibcast/Iallreduce
             pipeline of summa.hpp:196-215).  0/1 = unchunked.
+      collective_concurrency: 'free' (default) lets XLA's latency-hiding
+            scheduler put any number of the explicit schedule's collectives
+            in flight; 'solo' chains every collective in a SUMMA invocation
+            behind the previous one (optimization_barrier data dependency),
+            so at most one is on the wire at a time — the runtime
+            re-expression of the reference's COLLECTIVE_CONCURRENCY_SOLO
+            congestion experiment (compile flag, summa.hpp:179-192,
+            230-235).  The reference's LAYER variant (per-depth-layer
+            serialization) is subsumed: each depth layer's collectives
+            already form a chain per device in 'solo', and XLA schedules
+            per-program, not per-layer.  Same bytes and collective count —
+            only the overlap changes, which the alpha-beta cost model does
+            not price (it models launches, the scheduler owns overlap).
     """
 
     mesh: Mesh
     num_chunks: int = 0
+    collective_concurrency: str = "free"
+    layout: int = 0  # record of the device-ordering knob used at
+    # construction (the ordering itself lives in mesh.devices); carried so
+    # sweep rows over the layout axis stay attributable (reference
+    # topology.h ctor arg)
 
     # ---- constructors ------------------------------------------------------
 
@@ -153,6 +171,7 @@ class Grid:
         devices: Optional[Sequence[jax.Device]] = None,
         layout: int = 0,
         num_chunks: int = 0,
+        collective_concurrency: str = "free",
     ) -> "Grid":
         """Build a d x d x c grid from all (or the given) devices.
 
@@ -166,6 +185,8 @@ class Grid:
         return Grid(
             mesh=Mesh(_order_devices(devices, d, d, c, layout), AXES),
             num_chunks=num_chunks,
+            collective_concurrency=collective_concurrency,
+            layout=layout,
         )
 
     @staticmethod
@@ -176,6 +197,7 @@ class Grid:
         devices: Optional[Sequence[jax.Device]] = None,
         layout: int = 0,
         num_chunks: int = 0,
+        collective_concurrency: str = "free",
     ) -> "Grid":
         """Build a dx x dy x c grid (tunable shape, reference topo::rect).
 
@@ -190,6 +212,8 @@ class Grid:
         return Grid(
             mesh=Mesh(_order_devices(devices, dx, dy, c, layout), AXES),
             num_chunks=num_chunks,
+            collective_concurrency=collective_concurrency,
+            layout=layout,
         )
 
     @staticmethod

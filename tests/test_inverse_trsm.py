@@ -60,6 +60,30 @@ class TestRectri:
         )
         assert residual.inverse_residual(T, Tinv) < 1e-13
 
+    def test_explicit_tile_cyclic_balance(self, grid2x2x1):
+        # VERDICT r3 #5: the balanced side-L merge trmm wired into rectri —
+        # same results, balanced schedule engaged on large-enough windows
+        from capital_tpu.utils import tracing
+
+        T = jax.device_put(_tri(128, "L"), grid2x2x1.face_sharding())
+        cfg = RectriConfig(
+            base_case_dim=32, mode="explicit",
+            balance="tile_cyclic", balance_min_window=32,
+        )
+        with tracing.Recorder() as rec:
+            Tinv = jax.jit(lambda t: inverse.rectri(grid2x2x1, t, "L", cfg))(T)
+        assert residual.inverse_residual(T, Tinv) < 1e-13
+        ref = inverse.rectri(
+            grid2x2x1, T, "L", RectriConfig(base_case_dim=32, mode="explicit")
+        )
+        np.testing.assert_allclose(np.asarray(Tinv), np.asarray(ref), atol=1e-13)
+        # the balanced schedule must actually ENGAGE: every merge window
+        # here (64, 32 >= min_window 32) is tile-cyclic-eligible on the
+        # 2x2 face, so a fallback note means the balance plumb-through
+        # regressed to the block schedule
+        assert "trmm::tile_cyclic_fallback" not in rec.stats, rec.stats.keys()
+        assert any("RT::merge" in k for k in rec.stats), rec.stats.keys()
+
     def test_bad_inputs(self, grid2x2x1):
         with pytest.raises(ValueError):
             inverse.rectri(grid2x2x1, jnp.zeros((4, 6)))
@@ -104,6 +128,26 @@ class TestTrsm:
         Tn = np.asarray(T).T if trans_a else np.asarray(T)
         got = Tn @ np.asarray(X) if side == "L" else np.asarray(X) @ Tn
         np.testing.assert_allclose(got, np.asarray(B), rtol=1e-11, atol=1e-11)
+
+    @pytest.mark.parametrize("trans_a", [False, True])
+    def test_unit_diag(self, grid2x2x1, trans_a):
+        # Diag::AblasUnit parity (reference blas/engine.h:23-52): the
+        # diagonal is treated as ones without being read — garbage on the
+        # stored diagonal must not affect the solution
+        n, m = 64, 16
+        T = _tri(n, "L")
+        T = T.at[jnp.arange(n), jnp.arange(n)].set(1e30)  # poison the diag
+        B = jnp.asarray(rand48.random(n, m, key=27))
+        X = jax.jit(
+            lambda t, b: trsm.solve(
+                grid2x2x1, t, b, "L", "L", trans_a,
+                TrsmConfig(base_case_dim=16), unit_diag=True,
+            )
+        )(T, B)
+        T1 = np.tril(np.asarray(T), -1) + np.eye(n)
+        Tn = T1.T if trans_a else T1
+        np.testing.assert_allclose(Tn @ np.asarray(X), np.asarray(B),
+                                   rtol=1e-11, atol=1e-11)
 
     def test_odd_size_recursion(self, grid2x2x1):
         # n=100 with bc=16 once exercised uneven halving (50/50 -> 25/25...);

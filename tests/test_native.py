@@ -88,6 +88,38 @@ def test_predict_matches_fallback():
         assert np.all(out > 0)
 
 
+def test_predict_chunks_axis():
+    """num_chunks moves ONLY the alpha (collective-launch) term: monotone in
+    q on a mesh, identical bytes (round-4: the planner previously ignored
+    chunks, ranking every q identically), no-op on one device."""
+    bcs = [128, 256]
+    pols = [BaseCasePolicy.REPLICATE_COMM_COMP]
+    prev = None
+    for q in (0, 2, 4):
+        out, _ = native.cholinv_predict(
+            2048, (2, 2, 2), bcs, pols, peak_flops=1e14, num_chunks=q,
+        )
+        ref = np.array(
+            [[
+                native._predict_py(
+                    2048, 2, 2, 2, 1e14, 4.5e10, 1e-6, 2, bc, 0, 1, True, q
+                )
+                for bc in bcs
+            ]]
+        )
+        np.testing.assert_allclose(out, ref, rtol=1e-12)
+        if prev is not None:
+            assert np.all(out > prev)
+        prev = out
+    one, _ = native.cholinv_predict(
+        2048, (1, 1, 1), bcs, pols, peak_flops=1e14, num_chunks=4,
+    )
+    one0, _ = native.cholinv_predict(
+        2048, (1, 1, 1), bcs, pols, peak_flops=1e14,
+    )
+    np.testing.assert_allclose(one, one0)
+
+
 def test_predict_model_sanity():
     """Replicated base case should beat gather-to-root in predicted collective
     count; distributed grids pay communication a 1x1x1 grid does not."""

@@ -41,6 +41,42 @@ def test_plain_matmul(mats):
     _close(tri_matmul(A, B), A @ B)
 
 
+def test_f32_three_pass_high():
+    """precision='high' on f32 operands runs the in-kernel bf16x3
+    split-accumulate (VERDICT r3 #3): ~f32-grade accuracy, far better than
+    single-pass bf16, no in-kernel error."""
+    rng = np.random.default_rng(7)
+    n = 256
+    A = jnp.asarray(rng.standard_normal((n, n)), jnp.float32)
+    B = jnp.asarray(rng.standard_normal((n, n)), jnp.float32)
+    want = np.asarray(A, np.float64) @ np.asarray(B, np.float64)
+    scale = np.abs(want).max()
+
+    def err(precision):
+        got = tri_matmul(A, B, a_uplo="U", precision=precision)
+        ref = np.triu(np.asarray(A, np.float64)) @ np.asarray(B, np.float64)
+        return float(np.abs(np.asarray(got, np.float64) - ref).max()) / scale
+
+    e_high = err("high")
+    e_highest = err("highest")
+    e_bf16 = float(
+        np.abs(
+            np.asarray(
+                jnp.matmul(
+                    jnp.triu(A).astype(jnp.bfloat16), B.astype(jnp.bfloat16),
+                    preferred_element_type=jnp.float32,
+                ),
+                np.float64,
+            )
+            - np.triu(np.asarray(A, np.float64)) @ np.asarray(B, np.float64)
+        ).max()
+    ) / scale
+    # 3-pass lands within an order of magnitude of full f32 and far below
+    # single-pass bf16 (classic split-accumulate error profile)
+    assert e_high < 50 * max(e_highest, 1e-9)
+    assert e_high < e_bf16 / 20
+
+
 @pytest.mark.parametrize("uplo", ["U", "L"])
 @pytest.mark.parametrize("trans", [False, True])
 def test_a_triangular(mats, uplo, trans):

@@ -7,6 +7,7 @@ import pytest
 
 from capital_tpu.parallel import summa
 from capital_tpu.parallel.summa import GemmArgs, SyrkArgs, TrmmArgs
+from capital_tpu.parallel import topology as summa_topology
 from capital_tpu.utils import rand48
 
 MODES = ["xla", "explicit"]
@@ -345,6 +346,49 @@ def test_chunked_explicit_triangular(chunks):
         mode="explicit",
     )
     np.testing.assert_allclose(np.asarray(got2), -(A.T @ A) + C0, rtol=1e-12)
+
+
+class TestCollectiveConcurrency:
+    """Grid(collective_concurrency='solo'): the runtime re-expression of the
+    reference's COLLECTIVE_CONCURRENCY_SOLO compile flag (summa.hpp:179-192,
+    230-235) — every explicit-SUMMA collective chained behind the previous
+    one.  Identical results; the serialization barrier must be in the HLO."""
+
+    def _grids(self, base):
+        devs = list(base.mesh.devices.ravel())
+        free = summa_topology.Grid.rect(2, 2, 2, devices=devs)
+        solo = summa_topology.Grid.rect(
+            2, 2, 2, devices=devs, collective_concurrency="solo"
+        )
+        return free, solo
+
+    def test_solo_matches_free(self, grid2x2x2):
+        free, solo = self._grids(grid2x2x2)
+        A = jax.device_put(jnp.asarray(rand48.random(64, 64, key=51)),
+                           free.face_sharding())
+        B = jax.device_put(jnp.asarray(rand48.random(64, 64, key=52)),
+                           free.face_sharding())
+        want = jax.jit(lambda a, b: summa.gemm(free, a, b, mode="explicit"))(A, B)
+        got = jax.jit(lambda a, b: summa.gemm(solo, a, b, mode="explicit"))(A, B)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-12)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(A) @ np.asarray(B), rtol=1e-11
+        )
+
+    def test_solo_emits_barriers(self, grid2x2x2):
+        free, solo = self._grids(grid2x2x2)
+        A = jax.device_put(jnp.asarray(rand48.random(64, 64, key=53)),
+                           free.face_sharding())
+        txt_solo = jax.jit(
+            lambda a: summa.gemm(solo, a, a, mode="explicit")
+        ).lower(A).as_text()
+        txt_free = jax.jit(
+            lambda a: summa.gemm(free, a, a, mode="explicit")
+        ).lower(A).as_text()
+        assert "opt-barrier" in txt_solo or "optimization_barrier" in txt_solo
+        assert "opt-barrier" not in txt_free and (
+            "optimization_barrier" not in txt_free
+        )
 
 
 class TestTileCyclicBalance:

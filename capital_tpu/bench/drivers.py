@@ -355,27 +355,32 @@ def summa_gemm(args) -> dict:
     return rec
 
 
+def _tri_operand(n: int, dtype, seed: int = 0) -> jnp.ndarray:
+    """Well-conditioned lower-triangular bench operand, built DIRECTLY at
+    dtype (no chol-of-SPD setup — its two extra f32 n² staging buffers
+    OOM'd the n=32768 row on one v5e).  Off-diagonal scale 1/sqrt(n):
+    kappa ~ 2 at every n (measured 1.9-2.0 at 512-8192 in f64) while the
+    off-diagonal part carries ~23% of the matrix norm, so the --validate
+    residual gate still SEES off-diagonal bugs — a 1/n scale would shrink
+    them ~sqrt(n)x below the bf16 tolerance.  Shared by the rectri/trsm
+    drivers and bench.trace so the traced operand IS the benched one."""
+
+    @jax.jit
+    def _make(key):
+        G = jax.random.normal(key, (n, n), dtype=jnp.float32)
+        L = jnp.tril(G, -1) / jnp.sqrt(
+            jnp.asarray(n, jnp.float32)
+        ) + 3.0 * jnp.eye(n, dtype=jnp.float32)
+        return L.astype(dtype)
+
+    return jax.block_until_ready(_make(jax.random.key(seed)))
+
+
 def rectri(args) -> dict:
     grid = _grid(args)
     mode = _resolve_mode(args.mode, grid)
     dtype = jnp.dtype(args.dtype)
-
-    # well-conditioned triangular operand built DIRECTLY at dtype (no
-    # chol-of-SPD setup — its two extra f32 n² staging buffers OOM'd the
-    # n=32768 row on one v5e).  Off-diagonal scale 1/sqrt(n): kappa ~ 2 at
-    # every n (measured 1.9-2.0 at 512-8192 in f64) while the off-diagonal
-    # part carries ~23% of the matrix norm, so the --validate residual
-    # gate still SEES off-diagonal bugs — a 1/n scale would shrink them
-    # ~sqrt(n)x below the bf16 tolerance
-    @jax.jit
-    def _make(key):
-        G = jax.random.normal(key, (args.n, args.n), dtype=jnp.float32)
-        L = jnp.tril(G, -1) / jnp.sqrt(
-            jnp.asarray(args.n, jnp.float32)
-        ) + 3.0 * jnp.eye(args.n, dtype=jnp.float32)
-        return L.astype(dtype)
-
-    L = jax.block_until_ready(_make(jax.random.key(0)))
+    L = _tri_operand(args.n, dtype)
     cfg = inverse.RectriConfig(base_case_dim=args.bc, mode=mode)
 
     def step(a):
@@ -388,9 +393,11 @@ def rectri(args) -> dict:
     )
     if args.validate:
         Linv = jax.jit(lambda a: inverse.rectri(grid, a, "L", cfg))(L)
+        # row-blocked gate: the dense I − L·L⁻¹ is an n² f32 buffer that
+        # OOMs one v5e at n=49152 (falls back to dense for small n)
         _gate(
             "trtri_residual",
-            float(residual.inverse_residual(L, Linv)),
+            float(jax.jit(residual.inverse_residual_blocked)(L, Linv)),
             _tolerance(dtype),
         )
     return rec
@@ -472,18 +479,7 @@ def trsm(args) -> dict:
     grid = _grid(args)
     mode = _resolve_mode(args.mode, grid)
     dtype = jnp.dtype(args.dtype)
-
-    # same well-conditioned direct-at-dtype triangular operand as the
-    # rectri driver (kappa ~ 2, off-diagonal ~23% of the norm)
-    @jax.jit
-    def _make(key):
-        G = jax.random.normal(key, (args.n, args.n), dtype=jnp.float32)
-        L = jnp.tril(G, -1) / jnp.sqrt(
-            jnp.asarray(args.n, jnp.float32)
-        ) + 3.0 * jnp.eye(args.n, dtype=jnp.float32)
-        return L.astype(dtype)
-
-    L = jax.block_until_ready(_make(jax.random.key(0)))
+    L = _tri_operand(args.n, dtype)
     nrhs = args.m if args.m != 65536 or args.n >= 65536 else args.n
     B = jax.block_until_ready(
         jax.random.normal(jax.random.key(1), (args.n, nrhs), dtype=dtype)

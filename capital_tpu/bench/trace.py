@@ -289,6 +289,34 @@ def _cholinv_run(n: int, dtype, bc: int, iters: int, oneshot: bool):
     return run
 
 
+def _rectri_run(n: int, dtype, bc: int, iters: int):
+    from capital_tpu.bench.drivers import _tri_operand
+    from capital_tpu.models import inverse
+    from capital_tpu.parallel.topology import Grid
+
+    grid = Grid.square(c=1, devices=[jax.devices()[0]])
+    cfg = inverse.RectriConfig(
+        base_case_dim=bc, mode="pallas",
+        precision=None if jnp.dtype(dtype).itemsize < 4 else "highest",
+    )
+    T = _tri_operand(n, dtype)
+    eps = jnp.asarray(0.0, jnp.float32)
+
+    @jax.jit
+    def loop(a, eps, k):
+        def body(_, carry):
+            inv = inverse.rectri(grid, carry, "L", cfg)
+            return carry.at[0, 0].add(eps.astype(carry.dtype) * inv[0, 0])
+
+        return jnp.sum(jax.lax.fori_loop(0, k, body, a), dtype=jnp.float32)
+
+    def run():
+        float(loop(T, eps, iters))
+
+    run()
+    return run
+
+
 def _cacqr_run(m: int, n: int, dtype, bc: int, iters: int):
     from capital_tpu.models import cholesky, qr
     from capital_tpu.parallel.topology import Grid
@@ -324,7 +352,7 @@ def _cacqr_run(m: int, n: int, dtype, bc: int, iters: int):
 
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(prog="capital_tpu.bench.trace")
-    p.add_argument("algo", choices=["cholinv", "cacqr"])
+    p.add_argument("algo", choices=["cholinv", "cacqr", "rectri"])
     p.add_argument("--n", type=int, default=16384)
     p.add_argument("--m", type=int, default=1 << 20)
     p.add_argument("--bc", type=int, default=512)
@@ -343,6 +371,9 @@ def main(argv=None) -> None:
         label = f"cholinv n={args.n} bc={args.bc} {dtype}" + (
             " oneshot" if args.oneshot else ""
         )
+    elif args.algo == "rectri":
+        run = _rectri_run(args.n, dtype, args.bc, args.iters)
+        label = f"rectri n={args.n} bc={args.bc} {dtype}"
     else:
         run = _cacqr_run(args.m, args.n, dtype, args.bc, args.iters)
         label = f"cacqr {args.m}x{args.n} {dtype}"

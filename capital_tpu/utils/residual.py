@@ -106,7 +106,48 @@ def qr_residual_blocked(
 
 def inverse_residual(A: jnp.ndarray, Ainv: jnp.ndarray) -> jnp.ndarray:
     """‖I − A·A⁻¹‖_F / ‖I‖_F — reference test/inverse/validate.hpp:12-24
-    (that file is bit-rotted upstream; this is the working equivalent)."""
+    (that file is bit-rotted upstream; this is the working equivalent).
+    Error and norm accumulate at the f32 floor (same arithmetic as the
+    blocked form below, so the two gates agree for any n — the qr pair's
+    alignment rule)."""
     n = A.shape[0]
-    eye = jnp.eye(n, dtype=A.dtype)
-    return rel_fro(eye - jnp.matmul(A, Ainv, precision=_PREC), eye)
+    ct = jnp.promote_types(A.dtype, jnp.float32)
+    eye = jnp.eye(n, dtype=ct)
+    prod = jnp.matmul(A, Ainv, precision=_PREC, preferred_element_type=ct)
+    return rel_fro(eye - prod, eye)
+
+
+def inverse_residual_blocked(
+    A: jnp.ndarray, Ainv: jnp.ndarray, block_rows: int = 4096
+) -> jnp.ndarray:
+    """inverse_residual accumulated over row blocks with a lax.scan:
+    O(block·n) extra memory instead of the n x n f32 product — the dense
+    form OOMs validating the n=49152 rectri row on one v5e (two 4.8 GB
+    bf16 operands fit; the 9.7 GB f32 I−A·A⁻¹ did not).  Same qr_residual
+    pattern (qr_residual_blocked above).  Operands enter the contraction
+    at their own dtype with an f32-floor accumulator (no upcast copy of
+    Ainv — bf16 inputs are exact into f32, so values match the dense
+    form).  When block_rows does not tile n, the largest divisor of n
+    <= block_rows is used instead (no silent dense cliff at large
+    unaligned n); only n <= block_rows takes the dense form."""
+    n = A.shape[0]
+    if n <= block_rows:
+        return inverse_residual(A, Ainv)
+    br = next(b for b in range(min(block_rows, n), 0, -1) if n % b == 0)
+    ct = jnp.promote_types(A.dtype, jnp.float32)
+    Ab = A.reshape(n // br, br, n)
+
+    def step(carry, ab_i):
+        ab, i = ab_i
+        prod = jnp.matmul(ab, Ainv, precision=_PREC, preferred_element_type=ct)
+        # subtract this block's slice of the identity: rows
+        # [i*br, (i+1)*br) have their ones at the same global columns
+        r = jax.lax.broadcasted_iota(jnp.int32, (br, n), 0)
+        c = jax.lax.broadcasted_iota(jnp.int32, (br, n), 1)
+        err = jnp.where(c == r + i * br, prod - 1.0, prod)
+        return carry + jnp.sum(jnp.square(err)), None
+
+    num, _ = jax.lax.scan(
+        step, jnp.zeros((), ct), (Ab, jnp.arange(n // br))
+    )
+    return jnp.sqrt(num) / jnp.sqrt(jnp.asarray(n, ct))

@@ -334,9 +334,17 @@ def cholinv_space(
         cdict = {
             "policy": pol.name, "base_case_dim": bc, "split": split, "mode": mode,
         }
+        if grids is not None:
+            # topology parameters ride the config dict whenever a grids
+            # axis was passed — even a single-element axis may differ from
+            # the base grid, and the prefilter must model the topology the
+            # step actually measures on
+            cdict["grid"] = repr(g)
+            cdict["grid_shape"] = [g.dx, g.dy, g.c]
+            cdict["num_chunks"] = g.num_chunks
+            cdict["layout"] = getattr(g, "layout", 0)
         if len(glist) > 1:
             cid = f"{_gid(g)}_{cid}"
-            cdict["grid"] = repr(g)
         yield cid, cdict, step
 
 
@@ -390,30 +398,38 @@ def tune_cholinv(
     upgrade over the reference's measure-everything sweep (tune.cpp:239-253)."""
     A = _spd(n, dtype)
     configs = list(cholinv_space(grid, dtype, **space))
-    if prefilter_top_k and any("grid" in c[1] for c in configs):
-        # the native planner models one fixed topology; ranking configs
-        # from different grids against each other with the wrong topology
-        # would silently drop the best one
-        print("# autotune cholinv: --top-k ignored with a grid-shape axis")
-        prefilter_top_k = 0
     if prefilter_top_k and prefilter_top_k < len(configs):
         from capital_tpu import native
 
+        if len({c[1].get("layout", 0) for c in configs}) > 1:
+            # the alpha-beta model is layout-insensitive (device ordering
+            # is a locality knob): layout variants TIE in the ranking and
+            # a top-k cut keeps whichever was generated first — the
+            # dropped layouts go unmeasured
+            print(
+                "# autotune cholinv: --top-k with a layout axis prunes on "
+                "modeled cost only (layouts tie in the model)"
+            )
         spec = tracing.device_spec()
         peak = spec.peak_tflops(dtype) * 1e12 * 0.6
         preds = []
         for cid, cdict, step in configs:
+            # each config is modeled with ITS OWN topology (grid axis rows
+            # carry grid_shape/num_chunks in the config dict) — round 3
+            # disabled the prefilter under a grid axis; with chunks in the
+            # alpha term the model now ranks those rows too.  Layout
+            # variants tie (the model is layout-insensitive), so a top-k
+            # cut across a layout axis prunes on modeled cost only.
+            shape = tuple(cdict.get("grid_shape", (grid.dx, grid.dy, grid.c)))
+            q = cdict.get("num_chunks", grid.num_chunks)
             out, _ = native.cholinv_predict(
-                n, (grid.dx, grid.dy, grid.c),
+                n, shape,
                 [cdict["base_case_dim"]],
                 [BaseCasePolicy[cdict["policy"]]],
                 peak_flops=peak,
                 itemsize=jnp.dtype(dtype).itemsize,
                 split=cdict["split"],
-                # the topology's chunking rides into the alpha term (q-fold
-                # collective launches) — without this every q ranked alike
-                # (round-4 review finding)
-                num_chunks=grid.num_chunks,
+                num_chunks=q,
             )
             preds.append(float(out[0, 0]))
         order = sorted(range(len(configs)), key=preds.__getitem__)

@@ -105,18 +105,20 @@ def _shape_gate(name: str, m: int, n: int, bm: int, g: int) -> int:
 
 
 def pick_g(n: int, override: int = 0) -> int:
-    """Column-split auto-pick for the fused kernels.  Measured on v5e at
-    1M x 1024 bf16 (docs/PERF.md round-4 table): executed flops drop with g
-    ((g+1)/2g) while the per-dot MXU shapes shrink; g=8 (128-wide blocks)
-    was the measured winner, g=16 ineligible at n=1024.  Larger n keeps
-    128-wide blocks eligible at larger g; cap at 8 where the measured
-    curve flattened."""
+    """Column-split auto-pick for the fused kernels: the largest g whose
+    blocks stay 128-wide.  Measured on v5e (docs/PERF.md round-4 table):
+    executed flops drop with g ((g+1)/2g) and the curve stays monotone to
+    the 128-wide eligibility limit — 1M x 1024: 39.05/33.42/30.91 ms for
+    g=2/4/8 (g=16 ineligible); 512k x 2048: 62.27 (g=8) vs 55.09 (g=16)
+    ms.  Power-of-two n >= 256 take g = n/128 via the same rule; the gain
+    per doubling shrinks ((g+1)/2g -> 1/2) while per-dot shapes hold at
+    128, so 'largest eligible' stays right."""
     if override:
         return override if _eligible(1 << 20, n, 1024, override) else 0
-    for g in (8, 4, 2):
-        if _eligible(1 << 20, n, 1024, g):
-            return g
-    return 0
+    g = 2
+    while n % (2 * g * 128) == 0:  # divisibility implies 128-wide blocks
+        g *= 2
+    return g if _eligible(1 << 20, n, 1024, g) else 0
 
 
 def gram_blocked(

@@ -41,6 +41,28 @@ def test_cholinv_sweep_prefiltered(tmp_path):
     assert len(res) == 2  # pruned from 2 policies x 3 bc = 6
 
 
+def test_cholinv_prefilter_with_grid_axis(tmp_path):
+    """round 4: the prefilter models each config with ITS topology (round 3
+    disabled --top-k under a grid axis); chunked rows rank with q-fold
+    collective launches in the alpha term."""
+    devs = jax.devices("cpu")[:8]
+    grids = [
+        Grid.rect(2, 2, 2, devices=devs),
+        Grid.rect(2, 2, 2, devices=devs, num_chunks=2),
+    ]
+    res = sweep.tune_cholinv(
+        Grid.square(c=1, devices=devs[:1]), 128, jnp.float32, str(tmp_path),
+        prefilter_top_k=1, bc_dims=(32,), policies=(
+            sweep.BaseCasePolicy.REPLICATE_COMM_COMP,
+        ),
+        grids=grids,
+    )
+    assert len(res) == 1  # pruned from 2 grid rows, not disabled
+    # the ONLY axis is chunking: the model must prefer q=0 (fewer
+    # collective launches at identical bytes)
+    assert "q2" not in res[0].config_id
+
+
 def test_cacqr_sweep(tmp_path):
     grid = Grid.flat(devices=jax.devices("cpu")[:4])
     res = sweep.tune_cacqr(

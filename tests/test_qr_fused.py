@@ -86,6 +86,8 @@ class TestKernels:
 
     def test_pick_g(self):
         assert qr_fused.pick_g(1024) == 8
+        assert qr_fused.pick_g(2048) == 16  # 128-wide blocks still eligible
+        assert qr_fused.pick_g(4096) == 32
         assert qr_fused.pick_g(512) == 4
         assert qr_fused.pick_g(768) == 2  # 768 % 512 != 0, g=2 slabs OK
         assert qr_fused.pick_g(256) == 0  # g=2 demands n/2 >= 256

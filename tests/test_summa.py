@@ -375,6 +375,22 @@ class TestCollectiveConcurrency:
             np.asarray(got), np.asarray(A) @ np.asarray(B), rtol=1e-11
         )
 
+    def test_solo_cholinv_end_to_end(self, grid2x2x2):
+        # the knob must survive the full recursive algorithm (many SUMMA
+        # invocations, each chaining its own collectives)
+        from capital_tpu.models import cholesky
+        from capital_tpu.utils import rand48 as r48, residual
+
+        _, solo = self._grids(grid2x2x2)
+        A = jax.device_put(jnp.asarray(r48.symmetric(128)), solo.face_sharding())
+        R, Rinv = jax.jit(
+            lambda a: cholesky.factor(
+                solo, a, cholesky.CholinvConfig(base_case_dim=32, mode="explicit")
+            )
+        )(A)
+        assert float(residual.cholesky_residual(A, R)) < 1e-13
+        assert float(residual.cholesky_inverse_residual(R, Rinv)) < 1e-12
+
     def test_solo_emits_barriers(self, grid2x2x2):
         free, solo = self._grids(grid2x2x2)
         A = jax.device_put(jnp.asarray(rand48.random(64, 64, key=53)),

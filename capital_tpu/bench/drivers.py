@@ -477,7 +477,15 @@ def trsm(args) -> dict:
     from capital_tpu.models import trsm as trsm_mod
 
     grid = _grid(args)
-    mode = _resolve_mode(args.mode, grid)
+    # 'auto' resolves to xla for the invert leaf, not the usual single-TPU
+    # pallas pick: with diaginvert leaves every TRSM gemm is DENSE
+    # (off-diagonal updates + leaf multiplies), so the live-tile kernels'
+    # triangular bookkeeping is pure overhead (measured 163.9 vs 165.2
+    # TF/s at n=32768).  The solve leaf keeps the standard resolution.
+    if args.mode == "auto":
+        mode = "xla" if args.leaf == "invert" else _resolve_mode(args.mode, grid)
+    else:
+        mode = args.mode
     dtype = jnp.dtype(args.dtype)
     L = _tri_operand(args.n, dtype)
     nrhs = args.m if args.m != 65536 or args.n >= 65536 else args.n
@@ -485,7 +493,8 @@ def trsm(args) -> dict:
         jax.random.normal(jax.random.key(1), (args.n, nrhs), dtype=dtype)
     )
     cfg = trsm_mod.TrsmConfig(
-        base_case_dim=args.bc, mode=mode, precision=_precision(args, dtype)
+        base_case_dim=args.bc, mode=mode, precision=_precision(args, dtype),
+        leaf=args.leaf,
     )
 
     # L must be a REAL jit argument, not a step() closure: a closed-over
@@ -608,6 +617,10 @@ def build_parser() -> argparse.ArgumentParser:
         "drift guard; on by default under the suite driver on TPU",
     )
     p.add_argument("--newton-iters", type=int, default=30)
+    p.add_argument(
+        "--leaf", default="invert", choices=["invert", "solve"],
+        help="trsm leaf policy (TrsmConfig.leaf)",
+    )
     p.add_argument("--no-complete-inv", action="store_true")
     p.add_argument("--validate", action="store_true")
     p.add_argument("--scale", type=int, default=1, help="suite: divide problem sizes")

@@ -12,11 +12,14 @@ Schedule (lower-triangular, side='L' shown; others by symmetry):
     [L11  0 ] [X1]   [B1]      X1 = trsm(L11, B1)
     [L21 L22] [X2] = [B2]  ->  X2 = trsm(L22, B2 − L21·X1)
 
-The recursion is trace-time (static windows, like models/cholesky.py); the
-base case replicates the triangular panel and runs
+The recursion is trace-time (static windows, like models/cholesky.py).  Two
+leaf policies (TrsmConfig.leaf): 'invert' (default) precomputes ALL
+diagonal-block inverses in one batched kernel and turns every leaf into an
+MXU gemm — the design the reference subsystem's name (diaginvert) promises;
+'solve' replicates the triangular panel and runs
 lax.linalg.triangular_solve on every chip — same policy argument as the
 cholinv base case (SURVEY §7.1: replicate-and-recompute is the TPU-optimal
-base-case strategy).
+base-case strategy), kept for ill-conditioned diagonal blocks.
 """
 
 from __future__ import annotations
@@ -29,17 +32,53 @@ from jax import lax
 from capital_tpu.parallel import summa
 from capital_tpu.parallel.summa import GemmArgs
 from capital_tpu.parallel.topology import Grid
+from capital_tpu.utils import tracing
 
 
 @dataclasses.dataclass(frozen=True)
 class TrsmConfig:
     """Blocked-TRSM knobs (the reference's diaginvert policies were only
     forward-declared, trsm/diaginvert/policy.h:8-9; these are the working
-    equivalents)."""
+    equivalents).
+
+    leaf='invert' is the design the reference subsystem's NAME promises
+    (trsm::diaginvert — invert the diagonal blocks, then substitute): all
+    n/bc diagonal-block inverses are computed up front in ONE batched
+    kernel (they are independent — the parallelism the sequential
+    triangular_solve leaves throw away), and every leaf becomes an MXU
+    gemm against its precomputed inverse.  leaf='solve' keeps the
+    replicated lax.linalg.triangular_solve leaf — the numerically
+    stricter substitution form, for ill-conditioned diagonal blocks
+    (explicit-inverse multiply pays cond(D)·eps per leaf; the batched
+    inverses themselves are computed by substitution at >= f32)."""
 
     base_case_dim: int = 256
     mode: str = "xla"
     precision: str | None = "highest"
+    leaf: str = "invert"
+
+
+def _diag_block_inverses(
+    grid: Grid,
+    A: jnp.ndarray,
+    p: int,
+    bc: int,
+    lower: bool,
+    unit_diag: bool,
+) -> jnp.ndarray:
+    """(p/bc, bc, bc) stack of diagonal-block inverses of tri(A), computed
+    by ONE batched lapack.trtri (>= f32 compute dtype) and replicated —
+    the diaginvert precompute.  Total flops are p·bc² (negligible next to
+    the p²·nrhs substitution), and the batch axis restores the
+    parallelism the leaf-by-leaf custom calls serialize."""
+    from capital_tpu.ops import lapack
+
+    nb = p // bc
+    idx = jnp.arange(nb)
+    D = A.reshape(nb, bc, nb, bc)[idx, :, idx, :]
+    D = jnp.tril(D) if lower else jnp.triu(D)
+    Dinv = lapack.trtri(D, uplo="L" if lower else "U", unit_diag=unit_diag)
+    return lax.with_sharding_constraint(Dinv, grid.replicated_sharding())
 
 
 def _base_solve(
@@ -80,6 +119,8 @@ def solve(
         raise ValueError(f"side must be 'L' or 'R', got {side!r}")
     if uplo not in ("L", "U"):
         raise ValueError(f"uplo must be 'L' or 'U', got {uplo!r}")
+    if cfg.leaf not in ("invert", "solve"):
+        raise ValueError(f"leaf must be 'invert' or 'solve', got {cfg.leaf!r}")
     n = A.shape[0]
     if A.shape[0] != A.shape[1]:
         raise ValueError(f"triangular operand must be square, got {A.shape}")
@@ -96,30 +137,47 @@ def solve(
             False, cfg, unit_diag=unit_diag,
         )
 
-    # Distributed grids: pad A to bc·2^k at the boundary (diag(A, I) — stays
-    # triangular, solves the zero-padded RHS rows/cols to zeros) so every
+    # Padding (diag(A, I) — stays triangular, solves the zero-padded RHS
+    # rows/cols to zeros).  Distributed grids pad to bc·2^k so every
     # recursion window divides the grid face; odd halving would otherwise
-    # drop each window's placement to XLA with a per-call Grid.pin fallback
-    # warning (VERDICT r2 weak #5).  Single-device runs skip the pad: there
-    # is no face layout to lose, and misaligned windows already take the
-    # materializing fallbacks, so bc·2^k padding would only cost flops.
+    # drop each window's placement to XLA with a per-call Grid.pin
+    # fallback warning (VERDICT r2 weak #5).  A single device has no face
+    # layout to preserve, so the invert leaf only needs bc-ALIGNED
+    # windows: pad to the next multiple of bc (< bc rows for any n — a
+    # bc·2^k pad would near-quadruple the substitution flops at n just
+    # past a power of two) and let _solve_into split at block boundaries.
+    # Single-device leaf='solve' runs stay unpadded (misaligned windows
+    # already take the materializing fallbacks; padding would only cost
+    # flops).
+    bc = cfg.base_case_dim
     p = n
     if grid.num_devices > 1:
         from capital_tpu.models.cholesky import pad_embed_identity, padded_dim
 
-        p = padded_dim(n, cfg.base_case_dim)
-        if p != n:
-            A = pad_embed_identity(A, n, p)
-            pad = ((0, p - n), (0, 0)) if side == "L" else ((0, 0), (0, p - n))
-            B = jnp.pad(B, pad)
+        p = padded_dim(n, bc)
+    elif cfg.leaf == "invert" and n > bc:
+        from capital_tpu.models.cholesky import pad_embed_identity
+
+        p = -(-n // bc) * bc
+    if p != n:
+        A = pad_embed_identity(A, n, p)
+        pad = ((0, p - n), (0, 0)) if side == "L" else ((0, 0), (0, p - n))
+        B = jnp.pad(B, pad)
     A = grid.pin(A)
+
+    Dinv = None
+    if cfg.leaf == "invert" and p >= cfg.base_case_dim and p % cfg.base_case_dim == 0:
+        with tracing.scope("TS::dinv"):
+            Dinv = _diag_block_inverses(
+                grid, A, p, cfg.base_case_dim, lower, unit_diag
+            )
 
     # solved blocks land in a flat X buffer at their final offsets (no
     # per-level concatenate assembly — the cholinv/rectri flat-buffer
     # design); the updated right-hand sides still flow down as values,
     # which is inherent to the substitution order.
     X = grid.pin(jnp.zeros_like(B))
-    X = _solve_into(grid, A, B, X, 0, p, side, lower, unit_diag, cfg)
+    X = _solve_into(grid, A, B, X, 0, p, side, lower, unit_diag, cfg, Dinv)
     X = grid.pin(X)
     if p != n:
         X = X[:n, :] if side == "L" else X[:, :n]
@@ -137,6 +195,7 @@ def _solve_into(
     lower: bool,
     unit_diag: bool,
     cfg: TrsmConfig,
+    Dinv: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Solve the (off, off, size, size) window of tri(A) against the current
     right-hand-side value B (already narrowed to this window's rows/cols),
@@ -153,6 +212,17 @@ def _solve_into(
         return lax.dynamic_update_slice(Xbuf, val.astype(Xbuf.dtype), at)
 
     if size <= cfg.base_case_dim:
+        if Dinv is not None and size == cfg.base_case_dim:
+            # diaginvert leaf: one MXU gemm against the precomputed
+            # diagonal-block inverse (trace-time offset -> static index).
+            D = lax.index_in_dim(Dinv, off // cfg.base_case_dim, keepdims=False)
+            gargs = GemmArgs(precision=cfg.precision)
+            with tracing.scope("TS::leaf"):
+                if side == "L":
+                    V = summa.gemm(grid, D, B, None, gargs, mode=cfg.mode)
+                else:
+                    V = summa.gemm(grid, B, D, None, gargs, mode=cfg.mode)
+            return _put(X, V, off)
         Tw = lax.slice(A, (off, off), (off + size, off + size))
         return _put(
             X,
@@ -160,29 +230,42 @@ def _solve_into(
             off,
         )
 
-    n1 = size // 2
+    # Split at a block-aligned boundary when the window is a whole number
+    # of base-case blocks, so every leaf lands exactly bc-sized at a
+    # bc-aligned offset (the invert leaf's indexing premise).  On meshes
+    # (p = bc·2^k) this coincides with plain halving; on a single device
+    # it is what lets p be any multiple of bc.
+    bc = cfg.base_case_dim
+    if size % bc == 0:
+        n1 = (size // bc // 2) * bc
+    else:
+        n1 = size // 2
     n2 = size - n1
     o1, o2 = off, off + n1
     gargs = GemmArgs(alpha=-1.0, beta=1.0, precision=cfg.precision)
 
     if side == "L" and lower:
         A21 = lax.slice(A, (o2, o1), (o2 + n2, o1 + n1))
-        X = _solve_into(grid, A, B[:n1, :], X, o1, n1, side, lower, unit_diag, cfg)
-        B2 = summa.gemm(grid, A21, _xwin(o1, n1), B[n1:, :], gargs, mode=cfg.mode)
-        X = _solve_into(grid, A, B2, X, o2, n2, side, lower, unit_diag, cfg)
+        X = _solve_into(grid, A, B[:n1, :], X, o1, n1, side, lower, unit_diag, cfg, Dinv)
+        with tracing.scope("TS::update"):
+            B2 = summa.gemm(grid, A21, _xwin(o1, n1), B[n1:, :], gargs, mode=cfg.mode)
+        X = _solve_into(grid, A, B2, X, o2, n2, side, lower, unit_diag, cfg, Dinv)
     elif side == "L" and not lower:
         A12 = lax.slice(A, (o1, o2), (o1 + n1, o2 + n2))
-        X = _solve_into(grid, A, B[n1:, :], X, o2, n2, side, lower, unit_diag, cfg)
-        B1 = summa.gemm(grid, A12, _xwin(o2, n2), B[:n1, :], gargs, mode=cfg.mode)
-        X = _solve_into(grid, A, B1, X, o1, n1, side, lower, unit_diag, cfg)
+        X = _solve_into(grid, A, B[n1:, :], X, o2, n2, side, lower, unit_diag, cfg, Dinv)
+        with tracing.scope("TS::update"):
+            B1 = summa.gemm(grid, A12, _xwin(o2, n2), B[:n1, :], gargs, mode=cfg.mode)
+        X = _solve_into(grid, A, B1, X, o1, n1, side, lower, unit_diag, cfg, Dinv)
     elif side == "R" and lower:
         A21 = lax.slice(A, (o2, o1), (o2 + n2, o1 + n1))
-        X = _solve_into(grid, A, B[:, n1:], X, o2, n2, side, lower, unit_diag, cfg)
-        B1 = summa.gemm(grid, _xwin(o2, n2), A21, B[:, :n1], gargs, mode=cfg.mode)
-        X = _solve_into(grid, A, B1, X, o1, n1, side, lower, unit_diag, cfg)
+        X = _solve_into(grid, A, B[:, n1:], X, o2, n2, side, lower, unit_diag, cfg, Dinv)
+        with tracing.scope("TS::update"):
+            B1 = summa.gemm(grid, _xwin(o2, n2), A21, B[:, :n1], gargs, mode=cfg.mode)
+        X = _solve_into(grid, A, B1, X, o1, n1, side, lower, unit_diag, cfg, Dinv)
     else:  # side == "R", upper
         A12 = lax.slice(A, (o1, o2), (o1 + n1, o2 + n2))
-        X = _solve_into(grid, A, B[:, :n1], X, o1, n1, side, lower, unit_diag, cfg)
-        B2 = summa.gemm(grid, _xwin(o1, n1), A12, B[:, n1:], gargs, mode=cfg.mode)
-        X = _solve_into(grid, A, B2, X, o2, n2, side, lower, unit_diag, cfg)
+        X = _solve_into(grid, A, B[:, :n1], X, o1, n1, side, lower, unit_diag, cfg, Dinv)
+        with tracing.scope("TS::update"):
+            B2 = summa.gemm(grid, _xwin(o1, n1), A12, B[:, n1:], gargs, mode=cfg.mode)
+        X = _solve_into(grid, A, B2, X, o2, n2, side, lower, unit_diag, cfg, Dinv)
     return X

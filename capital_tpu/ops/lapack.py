@@ -45,9 +45,10 @@ def potrf(A: jnp.ndarray, uplo: str = "U") -> jnp.ndarray:
 
 def trtri(T: jnp.ndarray, uplo: str = "U", unit_diag: bool = False) -> jnp.ndarray:
     """Inverse of a triangular matrix.  Reference lapack::engine::_trtri
-    (interface.hpp:46-59)."""
+    (interface.hpp:46-59).  Leading batch dimensions invert as a stack in
+    one batched solve (the TRSM diaginvert leaf's precompute)."""
     ct = _compute_dtype(T.dtype)
-    eye = jnp.eye(T.shape[-1], dtype=ct)
+    eye = jnp.broadcast_to(jnp.eye(T.shape[-1], dtype=ct), T.shape)
     out = lax.linalg.triangular_solve(
         T.astype(ct), eye, left_side=True, lower=(uplo == "L"),
         unit_diagonal=unit_diag,

@@ -196,3 +196,70 @@ class TestTrsm:
             trsm.solve(grid2x2x1, T, jnp.zeros((8, 4)))  # shape mismatch
         with pytest.raises(ValueError):
             trsm.solve(grid2x2x1, T, jnp.zeros((16, 4)), side="X")
+
+    @pytest.mark.parametrize("side", ["L", "R"])
+    @pytest.mark.parametrize("uplo", ["L", "U"])
+    def test_invert_leaf_matches_solve_leaf(self, grid2x2x1, side, uplo):
+        # the diaginvert leaf (batched block inverses + gemm leaves) and the
+        # substitution leaf are the same operator; f64 pins them together
+        n, m = 96, 24  # 96 = 16·6: pads to 16·2^3 = 128 on the invert path
+        T = _tri(n, uplo)
+        Bshape = (n, m) if side == "L" else (m, n)
+        B = jnp.asarray(rand48.random(*Bshape, key=31))
+        Xs = [
+            trsm.solve(
+                grid2x2x1, T, B, side, uplo,
+                cfg=TrsmConfig(base_case_dim=16, leaf=leaf),
+            )
+            for leaf in ("invert", "solve")
+        ]
+        np.testing.assert_allclose(
+            np.asarray(Xs[0]), np.asarray(Xs[1]), rtol=1e-11, atol=1e-11
+        )
+
+    def test_invert_leaf_single_device_and_unit_diag(self):
+        # single-device invert path pads only to the next bc multiple
+        # (75 -> 80 = 5·16 — NOT bc·2^k, which would near-quadruple flops
+        # at n just past a power of two) and splits block-aligned (5 -> 2+3
+        # blocks, every leaf exactly bc); the batched inverse must ignore a
+        # poisoned stored diagonal under unit_diag — the Diag::AblasUnit
+        # contract holds leaf-for-leaf
+        from capital_tpu.parallel.topology import Grid
+
+        g1 = Grid.square(c=1, devices=jax.devices("cpu")[:1])
+        n, m = 75, 8
+        T = _tri(n, "L")
+        Tp = T.at[jnp.arange(n), jnp.arange(n)].set(1e30)  # poison
+        B = jnp.asarray(rand48.random(n, m, key=33))
+        X = jax.jit(
+            lambda t, b: trsm.solve(
+                g1, t, b, "L", "L",
+                cfg=TrsmConfig(base_case_dim=16, leaf="invert"),
+                unit_diag=True,
+            )
+        )(Tp, B)
+        T1 = np.tril(np.asarray(T), -1) + np.eye(n)
+        np.testing.assert_allclose(T1 @ np.asarray(X), np.asarray(B),
+                                   rtol=1e-11, atol=1e-11)
+
+    def test_invert_leaf_bad_value_and_pad_economy(self):
+        # leaf typos raise instead of silently taking the slow path, and the
+        # single-device invert pad stays under one bc block for any n
+        from capital_tpu.models.cholesky import padded_dim
+        from capital_tpu.parallel.topology import Grid
+
+        g1 = Grid.square(c=1, devices=jax.devices("cpu")[:1])
+        T = _tri(32, "L")
+        with pytest.raises(ValueError, match="leaf"):
+            trsm.solve(g1, T, jnp.zeros((32, 4)),
+                       cfg=TrsmConfig(base_case_dim=16, leaf="diaginvert"))
+        # n just past a power of two: bc·2^k padding would near-double the
+        # dimension (padded_dim(1040, 128) = 2048); the invert path pads to
+        # the next bc multiple instead and still solves correctly
+        n, bc = 1040, 128
+        assert padded_dim(n, bc) == 2048 and -(-n // bc) * bc == 1152
+        T = _tri(n, "L", key=29)
+        B = jnp.asarray(rand48.random(n, 8, key=30))
+        X = trsm.solve(g1, T, B, cfg=TrsmConfig(base_case_dim=bc, leaf="invert"))
+        r = np.asarray(T) @ np.asarray(X) - np.asarray(B)
+        assert np.max(np.abs(r)) < 1e-11

@@ -21,7 +21,7 @@ the iteration loop runs INSIDE one jit (lax.fori_loop with a data-dependent
 carry), the result is synced by a host transfer, and the per-iteration time
 is the delta between an (ITERS+1)-iteration run and a 1-iteration run.
 
-Usage: python bench.py [N] [dtype] [iters] [base_case_dim]
+Usage: python bench.py [N] [dtype] [iters] [base_case_dim] [precision]
 """
 
 from __future__ import annotations
@@ -141,6 +141,10 @@ def main() -> None:
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 49152
     dtype = jnp.dtype(sys.argv[2]) if len(sys.argv) > 2 else jnp.bfloat16
     iters = int(sys.argv[3]) if len(sys.argv) > 3 else 5
+    # argv[5]: matmul precision override for >= f32 dtypes ('high' = the
+    # in-kernel bf16x3 3-pass — f32-grade residuals at ~1.6x the default
+    # 6-pass 'highest' rate; docs/PERF.md "f32 round 4")
+    precision = sys.argv[5] if len(sys.argv) > 5 else None
 
     from capital_tpu.models import cholesky
     from capital_tpu.parallel.topology import Grid
@@ -181,7 +185,9 @@ def main() -> None:
     cfg = cholesky.CholinvConfig(
         base_case_dim=bc,
         mode="pallas",
-        precision=None if jnp.dtype(dtype).itemsize < 4 else "highest",
+        precision=(
+            None if jnp.dtype(dtype).itemsize < 4 else (precision or "highest")
+        ),
         schur_in_place=oneshot,
     )
 

@@ -381,7 +381,8 @@ def rectri(args) -> dict:
     mode = _resolve_mode(args.mode, grid)
     dtype = jnp.dtype(args.dtype)
     L = _tri_operand(args.n, dtype)
-    cfg = inverse.RectriConfig(base_case_dim=args.bc, mode=mode)
+    extra_cfg = {} if args.batch_below < 0 else {"batch_below": args.batch_below}
+    cfg = inverse.RectriConfig(base_case_dim=args.bc, mode=mode, **extra_cfg)
 
     def step(a):
         return inverse.rectri(grid, a, "L", cfg)
@@ -620,6 +621,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--leaf", default="invert", choices=["invert", "solve"],
         help="trsm leaf policy (TrsmConfig.leaf)",
+    )
+    p.add_argument(
+        "--batch-below", type=int, default=-1,
+        help="rectri batched-level-sweep threshold (-1 = config default)",
     )
     p.add_argument("--no-complete-inv", action="store_true")
     p.add_argument("--validate", action="store_true")

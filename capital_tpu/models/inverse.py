@@ -54,6 +54,90 @@ class RectriConfig:
     # No effect outside explicit mode (single-device pallas kernels skip
     # dead tiles natively).
     balance_min_window: int = 8192
+    batch_below: int = 0  # EXPERIMENTAL, off by default — a measured loser
+    # on the current stack (docs/PERF.md "rectri round 4: batched-prefix
+    # negative result").  > 0 on a single device: ALL diagonal windows <=
+    # this (on a bc·2^k-aligned plan) invert in one global batched prefix
+    # — one batched trtri over every base-case block plus, per level, one
+    # batched matmul pair over every sibling merge matrix-wide.  On paper
+    # that parallelizes what the depth-first walk serializes; measured on
+    # v5e, XLA's batched triangular_solve serializes internally (batch-32
+    # trtri = sequential leaves to within 6%) and the diagonal-block
+    # gathers materialize, so n=16384 regressed 14.4 -> 21.5 ms device.
+    # Kept behind the knob so future XLA versions can re-measure in one
+    # driver flag (--batch-below).
+
+
+def _batched_prefix_size(grid: Grid, p: int, cfg: RectriConfig) -> int:
+    """Largest level size t = bc·2^j <= batch_below the global batched
+    sweep can produce, or 0 when ineligible (a mesh — the stacks carry no
+    face layout — or a plan that is not a power-of-two chain of base
+    cases)."""
+    bc = cfg.base_case_dim
+    nb = p // bc
+    if not (
+        grid.num_devices == 1
+        and cfg.batch_below >= 2 * bc
+        and p % bc == 0
+        and nb & (nb - 1) == 0
+    ):
+        return 0
+    t = bc
+    while t * 2 <= min(cfg.batch_below, p):
+        t *= 2
+    return t if t > bc else 0
+
+
+def _rectri_batched_prefix(
+    grid: Grid,
+    Tp: jnp.ndarray,
+    out: jnp.ndarray,
+    p: int,
+    t: int,
+    cfg: RectriConfig,
+) -> jnp.ndarray:
+    """Invert ALL diagonal t-windows of Tp into `out` by global batched
+    level sweeps: ONE batched trtri over every base-case block (they are
+    independent — the parallelism the depth-first walk serializes), then
+    per level one batched A21 @ A11inv / A22inv @ (·) matmul pair over
+    every sibling merge matrix-wide.  The recursion above `t` then only
+    performs merges (its stop_at windows are already inverted here).
+    Merges run dense (2x the trmm flops).  Measured a net LOSER on the
+    current stack — see RectriConfig.batch_below and docs/PERF.md
+    "rectri round 4: batched-prefix negative result"."""
+    from capital_tpu.utils import tracing
+
+    bc = cfg.base_case_dim
+    with tracing.scope("RT::batch_base"):
+        nb = p // bc
+        idx = jnp.arange(nb)
+        D = Tp.reshape(nb, bc, nb, bc)[idx, :, idx, :]
+        W = lapack.trtri(jnp.tril(D), uplo="L")
+    s = bc
+    while s < t:
+        m = p // (2 * s)
+        with tracing.scope("RT::batch_merge"):
+            idx = jnp.arange(m)
+            blk = Tp.reshape(m, 2 * s, m, 2 * s)[idx, :, idx, :]
+            A21 = blk[:, s:, :s]
+            A11i, A22i = W[0::2], W[1::2]
+            M = jnp.matmul(A21, A11i, precision=cfg.precision)
+            B21 = -jnp.matmul(A22i, M, precision=cfg.precision)
+            W = jnp.concatenate(
+                [
+                    jnp.concatenate([A11i, jnp.zeros_like(A11i)], axis=2),
+                    jnp.concatenate([B21, A22i], axis=2),
+                ],
+                axis=1,
+            )
+        s *= 2
+    for i in range(p // t):
+        out = lax.dynamic_update_slice(
+            out,
+            lax.index_in_dim(W, i, keepdims=False).astype(out.dtype),
+            (i * t, i * t),
+        )
+    return out
 
 
 def _rectri_into(
@@ -63,11 +147,16 @@ def _rectri_into(
     off: int,
     size: int,
     cfg: RectriConfig,
+    stop_at: int = 0,
 ) -> jnp.ndarray:
     """Invert the lower-triangular window (off, off, size, size) of Tp into
     the same window of the flat buffer `out` (consumed; in-place on the
-    pallas path)."""
+    pallas path).  Windows <= stop_at are already inverted in `out` (the
+    global batched prefix) and pass through untouched."""
     from capital_tpu.utils import tracing
+
+    if size <= stop_at:
+        return out
 
     if size <= cfg.base_case_dim:
         with tracing.scope("RT::base"):
@@ -83,8 +172,8 @@ def _rectri_into(
 
     n1 = size // 2
     n2 = size - n1
-    out = _rectri_into(grid, Tp, out, off, n1, cfg)
-    out = _rectri_into(grid, Tp, out, off + n1, n2, cfg)
+    out = _rectri_into(grid, Tp, out, off, n1, cfg, stop_at)
+    out = _rectri_into(grid, Tp, out, off + n1, n2, cfg, stop_at)
     # B21 = −L22⁻¹ · L21 · L11⁻¹ (the TODO sketch at rectri.hpp:70-99),
     # as two triangular products read/written through views of the flat
     # buffers — the cholinv design (models/cholesky.py): no per-level
@@ -158,7 +247,10 @@ def rectri(
     # embed diag(T, I): stays lower-triangular, inverts to diag(T⁻¹, I)
     Tp = grid.pin(pad_embed_identity(T, n, p))
     out = grid.pin(jnp.zeros((p, p), dtype=T.dtype))
-    out = _rectri_into(grid, Tp, out, 0, p, cfg)
+    t = _batched_prefix_size(grid, p, cfg)
+    if t:
+        out = _rectri_batched_prefix(grid, Tp, out, p, t, cfg)
+    out = _rectri_into(grid, Tp, out, 0, p, cfg, stop_at=t)
     out = grid.pin(out)
     return out[:n, :n] if p != n else out
 

@@ -90,6 +90,31 @@ class TestRectri:
         with pytest.raises(ValueError):
             inverse.rectri(grid2x2x1, jnp.eye(4), uplo="X")
 
+    def test_batched_levels_single_device(self):
+        # the single-device batched level sweep (batched trtri leaves +
+        # batched dense merges; an off-by-default measured loser on TPU —
+        # docs/PERF.md) is the same operator as the depth-first recursion;
+        # f64 pins them together.  Eligibility is all-or-nothing on the
+        # padded plan: 256/320/300/511 all pad to a bc·2^k chain (prefix
+        # engages matrix-wide), while 700 pads to 768 = 24·32 (nb not a
+        # power of two — prefix refuses, pure recursion even with the knob
+        # set)
+        from capital_tpu.parallel.topology import Grid
+
+        g1 = Grid.square(c=1, devices=jax.devices("cpu")[:1])
+        for n in (256, 320, 300, 511, 700):
+            T = _tri(n, "L", key=41)
+            a = inverse.rectri(
+                g1, T, "L", RectriConfig(base_case_dim=32, batch_below=128)
+            )
+            b = inverse.rectri(
+                g1, T, "L", RectriConfig(base_case_dim=32, batch_below=0)
+            )
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-11, atol=1e-11
+            )
+            assert residual.inverse_residual(T, a) < 1e-12, n
+
 
 class TestNewton:
     def test_spd_inverse(self, grid2x2x1):

@@ -45,7 +45,8 @@ PHASE_TAGS = (
     "CI.factor_diag", "CI.trsm", "CI.tmu", "CI.inv",
     "CQR.gram", "CQR.chol", "CQR.scale", "CQR.merge", "CQR.fused",
     "CQR.formR",
-    "RT.base", "RT.merge",
+    "RT.base", "RT.merge", "RT.batch_base", "RT.batch_merge",
+    "TS.dinv", "TS.leaf", "TS.update",
 )
 
 
@@ -350,9 +351,42 @@ def _cacqr_run(m: int, n: int, dtype, bc: int, iters: int):
     return run
 
 
+def _trsm_run(n: int, nrhs: int, dtype, bc: int, iters: int):
+    from capital_tpu.bench.drivers import _tri_operand
+    from capital_tpu.models import trsm as trsm_mod
+    from capital_tpu.parallel.topology import Grid
+
+    grid = Grid.square(c=1, devices=[jax.devices()[0]])
+    cfg = trsm_mod.TrsmConfig(
+        base_case_dim=bc, mode="xla",
+        precision=None if jnp.dtype(dtype).itemsize < 4 else "highest",
+    )
+    L = _tri_operand(n, dtype)
+    B = jax.block_until_ready(
+        jax.random.normal(jax.random.key(1), (n, nrhs), dtype=dtype)
+    )
+    eps = jnp.asarray(0.0, jnp.float32)
+
+    @jax.jit
+    def loop(op, eps, k):
+        Lo, B0 = op
+
+        def body(_, carry):
+            X = trsm_mod.solve(grid, Lo, carry, side="L", uplo="L", cfg=cfg)
+            return carry + eps.astype(carry.dtype) * X
+
+        return jnp.sum(jax.lax.fori_loop(0, k, body, B0), dtype=jnp.float32)
+
+    def run():
+        float(loop((L, B), eps, iters))
+
+    run()
+    return run
+
+
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(prog="capital_tpu.bench.trace")
-    p.add_argument("algo", choices=["cholinv", "cacqr", "rectri"])
+    p.add_argument("algo", choices=["cholinv", "cacqr", "rectri", "trsm"])
     p.add_argument("--n", type=int, default=16384)
     p.add_argument("--m", type=int, default=1 << 20)
     p.add_argument("--bc", type=int, default=512)
@@ -374,6 +408,10 @@ def main(argv=None) -> None:
     elif args.algo == "rectri":
         run = _rectri_run(args.n, dtype, args.bc, args.iters)
         label = f"rectri n={args.n} bc={args.bc} {dtype}"
+    elif args.algo == "trsm":
+        nrhs = min(args.m, args.n)
+        run = _trsm_run(args.n, nrhs, dtype, args.bc, args.iters)
+        label = f"trsm n={args.n} nrhs={nrhs} bc={args.bc} {dtype}"
     else:
         run = _cacqr_run(args.m, args.n, dtype, args.bc, args.iters)
         label = f"cacqr {args.m}x{args.n} {dtype}"

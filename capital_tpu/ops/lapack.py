@@ -56,6 +56,84 @@ def trtri(T: jnp.ndarray, uplo: str = "U", unit_diag: bool = False) -> jnp.ndarr
     return out.astype(T.dtype)
 
 
+def trtri_stack(
+    D: jnp.ndarray,
+    uplo: str = "L",
+    unit_diag: bool = False,
+    inner: int = 128,
+    precision: str | None = None,
+) -> jnp.ndarray:
+    """Inverse of a (nb, bc, bc) stack of triangular blocks.
+
+    XLA:TPU's batched triangular_solve custom call serializes its batch
+    internally (measured: a batch-32 trtri of 512-blocks costs the same as
+    32 sequential calls — docs/PERF.md "rectri round 4: batched-prefix
+    negative result"), so the custom call is confined to `inner`-sized
+    sub-blocks (16x less serialized work at bc=512/inner=128) and the
+    bc-block inverses are assembled by batched MXU matmul merge levels:
+
+        [A11  0 ]^-1   [   A11inv     0   ]
+        [A21 A22]    = [-A22inv·A21·A11inv A22inv]
+
+    Falls back to the plain batched trtri when bc/inner is not a
+    power-of-two chain.  unit_diag applies to the stored diagonal of the
+    inner blocks (Diag::AblasUnit semantics, engine.h:23-52)."""
+    nb, bc = D.shape[0], D.shape[-1]
+    k = bc // inner if inner > 0 else 0
+    if k <= 1 or bc % inner or (k & (k - 1)):
+        return trtri(D, uplo=uplo, unit_diag=unit_diag)
+    lower = uplo == "L"
+    if not lower:
+        # one transpose each way keeps a single (lower) merge body
+        return jnp.swapaxes(
+            trtri_stack(
+                jnp.swapaxes(D, -1, -2), "L", unit_diag, inner, precision
+            ),
+            -1, -2,
+        )
+    # the whole chain runs at the >= f32 compute dtype and casts back ONCE
+    # (the module invariant): rounding W to a sub-f32 input dtype between
+    # merge levels measurably compounds (1.7x the plain-trtri error on a
+    # bf16 bc=256 stack).  Sub-f32 inputs also force >= 3-pass merge
+    # products — the upcast buys nothing if the matmuls drop back to
+    # 1-pass bf16.
+    ct = _compute_dtype(D.dtype)
+    if jnp.dtype(D.dtype).itemsize < 4:
+        precision = "highest"
+    Dm = jnp.tril(D).astype(ct)
+
+    def _dstack(o: int, s: int, stride: int):
+        # static slices, not reshape+fancy-indexing: the gather form scans
+        # the whole stack per extraction (the trsm TS::dinv lesson,
+        # models/trsm.py:_diag_block_inverses)
+        parts = [
+            lax.slice(
+                Dm,
+                (0, i * stride + o, i * stride),
+                (nb, i * stride + o + s, i * stride + s),
+            )
+            for i in range(bc // stride)
+        ]
+        return jnp.stack(parts, axis=1).reshape(nb * (bc // stride), s, s)
+
+    W = trtri(_dstack(0, inner, inner), uplo="L", unit_diag=unit_diag)
+    s = inner
+    while s < bc:
+        A21 = _dstack(s, s, 2 * s)
+        A11i, A22i = W[0::2], W[1::2]
+        M = jnp.matmul(A21, A11i, precision=precision)
+        B21 = -jnp.matmul(A22i, M, precision=precision)
+        W = jnp.concatenate(
+            [
+                jnp.concatenate([A11i, jnp.zeros_like(A11i)], axis=2),
+                jnp.concatenate([B21, A22i], axis=2),
+            ],
+            axis=1,
+        )
+        s *= 2
+    return W.astype(D.dtype)
+
+
 def potrf_trtri(A: jnp.ndarray, uplo: str = "U") -> tuple[jnp.ndarray, jnp.ndarray]:
     """Fused base-case pair: factor + triangular inverse in one call — the
     reference base case always computes both back to back

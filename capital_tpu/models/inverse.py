@@ -54,38 +54,45 @@ class RectriConfig:
     # No effect outside explicit mode (single-device pallas kernels skip
     # dead tiles natively).
     balance_min_window: int = 8192
-    batch_below: int = 0  # EXPERIMENTAL, off by default — a measured loser
-    # on the current stack (docs/PERF.md "rectri round 4: batched-prefix
-    # negative result").  > 0 on a single device: ALL diagonal windows <=
-    # this (on a bc·2^k-aligned plan) invert in one global batched prefix
-    # — one batched trtri over every base-case block plus, per level, one
-    # batched matmul pair over every sibling merge matrix-wide.  On paper
-    # that parallelizes what the depth-first walk serializes; measured on
-    # v5e, XLA's batched triangular_solve serializes internally (batch-32
-    # trtri = sequential leaves to within 6%) and the diagonal-block
-    # gathers materialize, so n=16384 regressed 14.4 -> 21.5 ms device.
-    # Kept behind the knob so future XLA versions can re-measure in one
-    # driver flag (--batch-below).
+    batch_below: int = -1  # single-device batched prefix threshold:
+    # -1 (default) = auto: batch ONLY the base cases (t = bc) — all p/bc
+    # leaf trtris collapse into one lapack.trtri_stack call (slice
+    # extraction + inner-block trtri + batched MXU merges) and the
+    # depth-first walk starts from stop_at=bc with every leaf already
+    # inverted.  Rectri's leaves, unlike cholinv's, have no sequential
+    # Schur dependency, so this is pure parallelism recovery.
+    # 0 = off.  > 0: ALSO run batched dense matmul merge levels for
+    # windows up to the threshold (values below bc clamp up to bc —
+    # base-only) — a measured LOSER at t >= 2·bc on this
+    # stack even after the gather->slice fix (the dense merges replace
+    # efficient trmms at 2x the flops; docs/PERF.md "rectri round 4:
+    # batched-prefix negative result"), kept re-measurable in one flag
+    # (--batch-below).
 
 
 def _batched_prefix_size(grid: Grid, p: int, cfg: RectriConfig) -> int:
-    """Largest level size t = bc·2^j <= batch_below the global batched
-    sweep can produce, or 0 when ineligible (a mesh — the stacks carry no
-    face layout — or a plan that is not a power-of-two chain of base
-    cases)."""
+    """Largest level size t = bc·2^j the global batched sweep should
+    produce (t = bc means base cases only — the default), or 0 when
+    ineligible (disabled, a mesh — the stacks carry no face layout — or a
+    plan that is not a power-of-two chain of base cases)."""
     bc = cfg.base_case_dim
     nb = p // bc
+    # any enabled setting keeps at least the base-only prefix: a positive
+    # batch_below below bc clamps up to bc rather than silently disabling
+    # the default win
+    limit = bc if cfg.batch_below < 0 else max(cfg.batch_below, bc)
     if not (
         grid.num_devices == 1
-        and cfg.batch_below >= 2 * bc
+        and cfg.batch_below != 0
         and p % bc == 0
+        and p >= bc
         and nb & (nb - 1) == 0
     ):
         return 0
     t = bc
-    while t * 2 <= min(cfg.batch_below, p):
+    while t * 2 <= min(limit, p):
         t *= 2
-    return t if t > bc else 0
+    return t
 
 
 def _rectri_batched_prefix(
@@ -96,30 +103,27 @@ def _rectri_batched_prefix(
     t: int,
     cfg: RectriConfig,
 ) -> jnp.ndarray:
-    """Invert ALL diagonal t-windows of Tp into `out` by global batched
-    level sweeps: ONE batched trtri over every base-case block (they are
-    independent — the parallelism the depth-first walk serializes), then
-    per level one batched A21 @ A11inv / A22inv @ (·) matmul pair over
-    every sibling merge matrix-wide.  The recursion above `t` then only
-    performs merges (its stop_at windows are already inverted here).
-    Merges run dense (2x the trmm flops).  Measured a net LOSER on the
-    current stack — see RectriConfig.batch_below and docs/PERF.md
-    "rectri round 4: batched-prefix negative result"."""
+    """Invert ALL diagonal t-windows of Tp into `out` by a global batched
+    prefix: ONE lapack.trtri_stack over every base-case block (rectri's
+    leaves are independent — the parallelism the depth-first walk
+    serializes), then per level (t > bc only) one batched
+    A21 @ A11inv / A22inv @ (·) matmul pair over every sibling merge
+    matrix-wide.  The recursion above `t` then only performs merges (its
+    stop_at windows are already inverted here).  The default is t = bc —
+    base cases only; the dense matmul levels above bc are a measured
+    loser (2x the trmm flops; docs/PERF.md "rectri round 4")."""
     from capital_tpu.utils import tracing
 
     bc = cfg.base_case_dim
     with tracing.scope("RT::batch_base"):
-        nb = p // bc
-        idx = jnp.arange(nb)
-        D = Tp.reshape(nb, bc, nb, bc)[idx, :, idx, :]
-        W = lapack.trtri(jnp.tril(D), uplo="L")
+        W = lapack.trtri_stack(
+            jnp.tril(lapack.diag_block_stack(Tp, 0, bc, bc)), uplo="L",
+            precision=cfg.precision,
+        )
     s = bc
     while s < t:
-        m = p // (2 * s)
         with tracing.scope("RT::batch_merge"):
-            idx = jnp.arange(m)
-            blk = Tp.reshape(m, 2 * s, m, 2 * s)[idx, :, idx, :]
-            A21 = blk[:, s:, :s]
+            A21 = lapack.diag_block_stack(Tp, s, s, 2 * s)
             A11i, A22i = W[0::2], W[1::2]
             M = jnp.matmul(A21, A11i, precision=cfg.precision)
             B21 = -jnp.matmul(A22i, M, precision=cfg.precision)

@@ -75,16 +75,7 @@ def _diag_block_inverses(
     confined to 128-sub-blocks and merged up with batched MXU products."""
     from capital_tpu.ops import lapack
 
-    # static slices, NOT reshape+advanced-indexing: the fancy-index form
-    # lowers to a gather that scans the full n² operand (~2.6 ms of the
-    # measured 3.2 ms TS::dinv at n=32768 — the blocks themselves are 33 MB)
-    nb = p // bc
-    D = jnp.stack(
-        [
-            lax.slice(A, (i * bc, i * bc), ((i + 1) * bc, (i + 1) * bc))
-            for i in range(nb)
-        ]
-    )
+    D = lapack.diag_block_stack(A, 0, bc, bc)
     D = jnp.tril(D) if lower else jnp.triu(D)
     Dinv = lapack.trtri_stack(
         D, uplo="L" if lower else "U", unit_diag=unit_diag,

@@ -56,6 +56,30 @@ def trtri(T: jnp.ndarray, uplo: str = "U", unit_diag: bool = False) -> jnp.ndarr
     return out.astype(T.dtype)
 
 
+def diag_block_stack(X: jnp.ndarray, o: int, s: int, stride: int) -> jnp.ndarray:
+    """(count, s, s) stack of the diagonal-band blocks
+    ``X[..., i*stride + o : i*stride + o + s, i*stride : i*stride + s]``,
+    flattened over any leading batch dim (o=0 gives the diagonal blocks
+    themselves; o=s, stride=2s gives the per-pair subdiagonal blocks of a
+    merge level).  Built from static lax.slice per block, NOT
+    reshape+fancy-indexing: the gather form lowers to a scan of the WHOLE
+    operand (measured ~2.6 ms scanning a 2.1 GB matrix for 33 MB of
+    blocks — the trsm TS::dinv lesson, docs/PERF.md).  Shared by
+    trtri_stack, the trsm diaginvert precompute, and the rectri batched
+    prefix so the lowering fix cannot drift apart."""
+    count = X.shape[-2] // stride
+    lo = (0,) * (X.ndim - 2)
+    parts = [
+        lax.slice(
+            X,
+            lo + (i * stride + o, i * stride),
+            X.shape[:-2] + (i * stride + o + s, i * stride + s),
+        )
+        for i in range(count)
+    ]
+    return jnp.stack(parts, axis=X.ndim - 2).reshape((-1, s, s))
+
+
 def trtri_stack(
     D: jnp.ndarray,
     uplo: str = "L",
@@ -75,12 +99,18 @@ def trtri_stack(
         [A11  0 ]^-1   [   A11inv     0   ]
         [A21 A22]    = [-A22inv·A21·A11inv A22inv]
 
-    Falls back to the plain batched trtri when bc/inner is not a
-    power-of-two chain.  unit_diag applies to the stored diagonal of the
-    inner blocks (Diag::AblasUnit semantics, engine.h:23-52)."""
+    `inner` is a ceiling, not an exact size: the call uses the largest
+    bc/2^j <= inner (bc=384 -> 96, bc=512 -> 128), falling back to the
+    plain batched trtri when halving cannot reach the ceiling (odd bc
+    above it).  unit_diag applies to the stored diagonal of the inner
+    blocks (Diag::AblasUnit semantics, engine.h:23-52)."""
     nb, bc = D.shape[0], D.shape[-1]
-    k = bc // inner if inner > 0 else 0
-    if k <= 1 or bc % inner or (k & (k - 1)):
+    d = bc
+    while inner > 0 and d > inner and d % 2 == 0:
+        d //= 2
+    k = bc // d if 0 < d <= inner else 0
+    inner = d
+    if k <= 1:
         return trtri(D, uplo=uplo, unit_diag=unit_diag)
     lower = uplo == "L"
     if not lower:
@@ -102,24 +132,10 @@ def trtri_stack(
         precision = "highest"
     Dm = jnp.tril(D).astype(ct)
 
-    def _dstack(o: int, s: int, stride: int):
-        # static slices, not reshape+fancy-indexing: the gather form scans
-        # the whole stack per extraction (the trsm TS::dinv lesson,
-        # models/trsm.py:_diag_block_inverses)
-        parts = [
-            lax.slice(
-                Dm,
-                (0, i * stride + o, i * stride),
-                (nb, i * stride + o + s, i * stride + s),
-            )
-            for i in range(bc // stride)
-        ]
-        return jnp.stack(parts, axis=1).reshape(nb * (bc // stride), s, s)
-
-    W = trtri(_dstack(0, inner, inner), uplo="L", unit_diag=unit_diag)
+    W = trtri(diag_block_stack(Dm, 0, inner, inner), uplo="L", unit_diag=unit_diag)
     s = inner
     while s < bc:
-        A21 = _dstack(s, s, 2 * s)
+        A21 = diag_block_stack(Dm, s, s, 2 * s)
         A11i, A22i = W[0::2], W[1::2]
         M = jnp.matmul(A21, A11i, precision=precision)
         B21 = -jnp.matmul(A22i, M, precision=precision)

@@ -61,7 +61,6 @@ class TrsmConfig:
 def _diag_block_inverses(
     grid: Grid,
     A: jnp.ndarray,
-    p: int,
     bc: int,
     lower: bool,
     unit_diag: bool,
@@ -172,7 +171,7 @@ def solve(
     if cfg.leaf == "invert" and p >= cfg.base_case_dim and p % cfg.base_case_dim == 0:
         with tracing.scope("TS::dinv"):
             Dinv = _diag_block_inverses(
-                grid, A, p, cfg.base_case_dim, lower, unit_diag, cfg
+                grid, A, cfg.base_case_dim, lower, unit_diag, cfg
             )
 
     # solved blocks land in a flat X buffer at their final offsets (no

@@ -1,4 +1,4 @@
-"""CLI: python -m capital_tpu.autotune {cholinv,cacqr} [flags]."""
+"""CLI: python -m capital_tpu.autotune {cholinv,cacqr,trsm} [flags]."""
 
 from __future__ import annotations
 
@@ -9,7 +9,7 @@ import jax
 
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(prog="capital_tpu.autotune")
-    p.add_argument("alg", choices=["cholinv", "cacqr"])
+    p.add_argument("alg", choices=["cholinv", "cacqr", "trsm"])
     p.add_argument("--n", type=int, default=4096)
     p.add_argument("--m", type=int, default=65536)
     p.add_argument("--dtype", default="bfloat16")
@@ -18,8 +18,9 @@ def main(argv=None) -> None:
     p.add_argument(
         "--modes", nargs="+", default=None,
         choices=["xla", "explicit", "pallas"],
-        help="cholinv: SUMMA modes to sweep (the winning flagship config is "
-        "pallas on one TPU — a sweep that cannot reach it is useless)",
+        help="cholinv/trsm: SUMMA modes to sweep (the winning flagship "
+        "config is pallas on one TPU for cholinv, xla for trsm — a sweep "
+        "that cannot reach it is useless)",
     )
     p.add_argument("--splits", type=int, nargs="+", default=None)
     p.add_argument(
@@ -133,6 +134,19 @@ def main(argv=None) -> None:
         )
         res = sweep.tune_cholinv(
             grid, args.n, dtype, args.out, prefilter_top_k=args.top_k,
+            checkpoint=args.resume, **space,
+        )
+    elif args.alg == "trsm":
+        if "grids" in space:
+            p.error("--grids is not a trsm sweep axis (bc x leaf x mode only)")
+        if args.modes:
+            space["modes"] = tuple(args.modes)
+        grid = Grid.square(c=1, devices=dev)
+        # the driver's nrhs convention (drivers.py trsm): --m is honored
+        # whenever it is not the untouched 65536 default, else nrhs = n
+        nrhs = args.m if args.m != 65536 else args.n
+        res = sweep.tune_trsm(
+            grid, args.n, nrhs, dtype, args.out,
             checkpoint=args.resume, **space,
         )
     else:

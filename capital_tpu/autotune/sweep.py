@@ -383,6 +383,67 @@ def cacqr_space(
         yield cid, cdict, step
 
 
+def trsm_space(
+    grid: Grid,
+    dtype,
+    L,
+    bc_dims: Iterable[int] = (256, 512, 1024),
+    leaves: Iterable[str] = ("invert", "solve"),
+    modes: Iterable[str] = ("xla",),
+):
+    """bc x leaf x mode for the finished TRSM (the reference's diaginvert
+    policies were forward-declared only, trsm/diaginvert/policy.h:8-9 —
+    this is the sweep its tune.cpp never got).  The triangular operand L
+    rides as a closure constant, so sweeps are bounded to moderate n
+    (<= ~8192): at n >= 16384 a closed-over n x n array serializes into
+    the program past the compile server's request limit (HTTP 413 — the
+    trsm driver's jit-argument loop is the large-n path)."""
+    from capital_tpu.models import trsm as trsm_mod
+
+    prec = None if jnp.dtype(dtype).itemsize < 4 else "highest"
+    for bc, leaf, mode in itertools.product(bc_dims, leaves, modes):
+        cfg = trsm_mod.TrsmConfig(
+            base_case_dim=bc, mode=mode, precision=prec, leaf=leaf
+        )
+
+        def step(b, cfg=cfg):
+            return trsm_mod.solve(grid, L, b, "L", "L", cfg=cfg)
+
+        yield (
+            f"bc{bc}_{leaf}_{mode}",
+            {"base_case_dim": bc, "leaf": leaf, "mode": mode},
+            step,
+        )
+
+
+def tune_trsm(
+    grid: Grid,
+    n: int,
+    nrhs: int,
+    dtype=jnp.bfloat16,
+    out_dir: str = "autotune_out",
+    checkpoint: bool = False,
+    **space,
+) -> list[SweepResult]:
+    from capital_tpu.bench.drivers import _tri_operand
+
+    if n > 8192:
+        raise ValueError(
+            f"tune_trsm: n={n} exceeds the sweep bound (8192): the closed-"
+            "over n x n operand serializes into every config's program and "
+            "breaks the compile server at n >= 16384 (HTTP 413) — use the "
+            "trsm bench driver's jit-argument loop for large-n measurement"
+        )
+    L = _tri_operand(n, dtype)
+    B = jax.block_until_ready(
+        jax.random.normal(jax.random.key(1), (n, nrhs), dtype=dtype)
+    )
+    return run_sweep(
+        "trsm", trsm_space(grid, dtype, L, **space), B, out_dir, dtype=dtype,
+        checkpoint=checkpoint, key_extra={**_grid_key(grid), "n": n},
+    )
+
+
 def tune_cholinv(
     grid: Grid,
     n: int,

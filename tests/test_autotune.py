@@ -74,6 +74,23 @@ def test_cacqr_sweep(tmp_path):
     assert os.path.exists(tmp_path / "cacqr_best.json")
 
 
+def test_trsm_sweep(tmp_path):
+    """bc x leaf x mode over the finished TRSM (the sweep the reference's
+    stubbed diaginvert never got)."""
+    grid = Grid.square(c=1, devices=jax.devices("cpu")[:1])
+    res = sweep.tune_trsm(
+        grid, 128, 64, jnp.float32, str(tmp_path),
+        bc_dims=(32, 64), leaves=("invert", "solve"),
+    )
+    assert len(res) == 4
+    ids = {r.config_id for r in res}
+    assert ids == {
+        "bc32_invert_xla", "bc32_solve_xla", "bc64_invert_xla", "bc64_solve_xla"
+    }
+    assert any("TS::update" in k for k in res[0].recorder.stats)
+    assert os.path.exists(tmp_path / "trsm_best.json")
+
+
 def test_sweep_resume_skips_measured_configs(tmp_path, monkeypatch):
     """A preempted sweep re-run with checkpoint=True resumes: configs in the
     per-config checkpoint are not re-measured, results/tables are identical,

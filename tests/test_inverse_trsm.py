@@ -267,6 +267,21 @@ class TestTrsm:
         np.testing.assert_allclose(T1 @ np.asarray(X), np.asarray(B),
                                    rtol=1e-11, atol=1e-11)
 
+    def test_explicit_mode_mesh(self, grid2x2x2):
+        # the full explicit-SUMMA schedule under the TRSM recursion on the
+        # 3D mesh, diaginvert leaves included — completes the
+        # mode='explicit' coverage the other three model families have
+        n, m = 128, 16
+        T = jax.device_put(_tri(n, "L"), grid2x2x2.face_sharding())
+        B = jnp.asarray(rand48.random(n, m, key=35))
+        X = trsm.solve(
+            grid2x2x2, T, B, "L", "L",
+            cfg=TrsmConfig(base_case_dim=32, mode="explicit"),
+        )
+        np.testing.assert_allclose(
+            np.asarray(T) @ np.asarray(X), np.asarray(B), rtol=1e-11, atol=1e-11
+        )
+
     def test_invert_leaf_bad_value_and_pad_economy(self):
         # leaf typos raise instead of silently taking the slow path, and the
         # single-device invert pad stays under one bc block for any n

@@ -382,7 +382,10 @@ def rectri(args) -> dict:
     dtype = jnp.dtype(args.dtype)
     L = _tri_operand(args.n, dtype)
     extra_cfg = {} if args.batch_below < 0 else {"batch_below": args.batch_below}
-    cfg = inverse.RectriConfig(base_case_dim=args.bc, mode=mode, **extra_cfg)
+    cfg = inverse.RectriConfig(
+        base_case_dim=args.bc, mode=mode,
+        precision=_precision(args, dtype), **extra_cfg,
+    )
 
     def step(a):
         return inverse.rectri(grid, a, "L", cfg)
@@ -411,7 +414,10 @@ def newton(args) -> dict:
     mode = args.mode if args.mode != "auto" else "xla"
     dtype = jnp.dtype(args.dtype)
     A = _spd(args.n, dtype)
-    cfg = inverse.NewtonConfig(max_iter=args.newton_iters, mode=mode)
+    cfg = inverse.NewtonConfig(
+        max_iter=args.newton_iters, mode=mode,
+        precision=_precision(args, dtype),
+    )
 
     def step(a):
         X, _ = inverse.newton(grid, a, cfg)
@@ -544,10 +550,13 @@ def trsm(args) -> dict:
                 grid, solve_op, b, side=side, uplo=uplo, cfg=cfg,
                 unit_diag=unit,
             )
+            # gate matmul at 'highest' like every residual.* helper
+            # (residual.py _PREC note): the default f32 product floors the
+            # measurable residual near 1e-3 and fails a CORRECT f32 solve
             got = (
-                jnp.matmul(Tf, X.astype(jnp.float32))
+                jnp.matmul(Tf, X.astype(jnp.float32), precision="highest")
                 if side == "L"
-                else jnp.matmul(X.astype(jnp.float32), Tf)
+                else jnp.matmul(X.astype(jnp.float32), Tf, precision="highest")
             )
             return residual.rel_fro(got - b.astype(jnp.float32), b)
 

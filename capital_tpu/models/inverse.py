@@ -291,7 +291,22 @@ def newton(
     n = A.shape[0]
     tol = cfg.tol
     if tol is None:
-        tol = 50.0 * float(jnp.finfo(A.dtype).eps)
+        # auto-tol from the EFFECTIVE arithmetic, not the storage dtype:
+        # f32 on the TPU MXU computes at the precision setting's pass
+        # count, and a tol below the reachable residual plateau means the
+        # early exit never fires and the loop burns its full budget
+        # (measured: 'high' plateaus at 1.3e-5 > 50*eps_f32 at n=8192,
+        # 30/30 iterations executed for the same result).  f64 keeps the
+        # storage eps — its custom calls compute at full precision.
+        eps = float(jnp.finfo(A.dtype).eps)
+        if jnp.dtype(A.dtype).itemsize == 4:
+            if cfg.precision == "high":
+                eps = max(eps, 2.0**-21)  # bf16x3 split-accumulate roundoff
+            elif cfg.precision in (None, "default"):
+                # default f32 gemms run 1-pass bf16-grade on the MXU —
+                # same floor the bf16 storage dtype already gets
+                eps = max(eps, float(jnp.finfo(jnp.bfloat16).eps))
+        tol = 50.0 * eps
     A = grid.pin(A)
     eye = grid.pin(jnp.eye(n, dtype=A.dtype))
     # ‖A‖₁ = max col abs sum, ‖A‖∞ = max row abs sum (the reference computes

@@ -299,13 +299,8 @@ def newton(
         # 30/30 iterations executed for the same result).  f64 keeps the
         # storage eps — its custom calls compute at full precision.
         eps = float(jnp.finfo(A.dtype).eps)
-        if jnp.dtype(A.dtype).itemsize == 4:
-            if cfg.precision == "high":
-                eps = max(eps, 2.0**-21)  # bf16x3 split-accumulate roundoff
-            elif cfg.precision in (None, "default"):
-                # default f32 gemms run 1-pass bf16-grade on the MXU —
-                # same floor the bf16 storage dtype already gets
-                eps = max(eps, float(jnp.finfo(jnp.bfloat16).eps))
+        if jnp.dtype(A.dtype).itemsize == 4 and cfg.precision == "high":
+            eps = max(eps, 2.0**-21)  # bf16x3 split-accumulate roundoff
         tol = 50.0 * eps
     A = grid.pin(A)
     eye = grid.pin(jnp.eye(n, dtype=A.dtype))

@@ -236,38 +236,59 @@ def _split_bf16(x):
     return hi, lo
 
 
+def precision_dot(a, b, dimension_numbers, acc_dtype, precision):
+    """dot_general with the Mosaic-safe precision rules — the ONE copy of
+    the rule set shared by every in-kernel contraction (here and
+    ops/qr_fused; the two copies had already diverged once):
+
+    * f32 x f32 at 'high' into an f32 accumulator: the in-kernel bf16x3
+      split-accumulate — each operand decomposes into bf16 hi+lo and three
+      bf16 MXU passes accumulate hi·hi + hi·lo + lo·hi (lo·lo is below
+      f32 roundoff).  Mosaic's dot_general has no HIGH lowering
+      (NotImplementedError on hardware); ~2x the 6-pass 'highest'
+      throughput at f32-grade accuracy (VERDICT r3 #3).
+    * any other 'high' shape rounds up to 'highest' (full passes, never an
+      error);
+    * a sub-f32 operand drops the request entirely: single-pass exact into
+      the f32 accumulator, and Mosaic rejects fp32 contract precision on
+      bf16 inputs outright ("Bad lhs type")."""
+    if (
+        precision == "high"
+        and a.dtype == jnp.float32
+        and b.dtype == jnp.float32
+        and jnp.dtype(acc_dtype) == jnp.float32
+    ):
+        ah, al = _split_bf16(a)
+        bh, bl = _split_bf16(b)
+
+        def d(x, y):
+            return jax.lax.dot_general(
+                x, y, dimension_numbers=dimension_numbers,
+                preferred_element_type=acc_dtype,
+            )
+
+        return d(ah, bh) + (d(ah, bl) + d(al, bh))
+    if precision == "high":
+        precision = "highest"
+    if precision is not None and (
+        jnp.dtype(a.dtype).itemsize < 4 or jnp.dtype(b.dtype).itemsize < 4
+    ):
+        precision = None
+    return jax.lax.dot_general(
+        a, b, dimension_numbers=dimension_numbers,
+        preferred_element_type=acc_dtype, precision=precision,
+    )
+
+
 def _make_accumulate(
     *, a_uplo, a_trans, b_uplo, b_trans, bm, bn, bk, acc_dtype, precision,
     operand_dtypes=(),
 ):
     """The shared inner body: mask diagonal-straddling tiles against global
-    indices, contract on the MXU, accumulate into VMEM scratch."""
-
-    # precision HIGH for f32 operands: Mosaic's dot_general has no 3-pass
-    # mode, so the split-accumulate is spelled in-kernel — each f32 tile
-    # decomposes into bf16 hi+lo and three bf16 MXU passes accumulate
-    # hi·hi + hi·lo + lo·hi into the f32 scratch (lo·lo is below f32
-    # roundoff).  ~2x the 6-pass 'highest' throughput at f32-grade
-    # accuracy, and the dead-block skipping stays (VERDICT r3 #3: the f32
-    # story previously stopped at 'high'-rounds-up-to-highest).
-    three_pass = (
-        precision == "high"
-        and operand_dtypes
-        and all(jnp.dtype(d) == jnp.float32 for d in operand_dtypes)
-        and jnp.dtype(acc_dtype) == jnp.float32
-    )
-    if precision == "high":
-        # non-f32 shapes keep the round-up (full passes, never an error)
-        precision = "highest"
-    # sub-f32 operands are single-pass exact into the f32 accumulator —
-    # 'highest' adds nothing, and Mosaic rejects fp32 contract precision on
-    # bf16 inputs outright ("Bad lhs type"), so drop the request
-    if (
-        precision is not None
-        and operand_dtypes
-        and all(jnp.dtype(d).itemsize < 4 for d in operand_dtypes)
-    ):
-        precision = None
+    indices, contract on the MXU via precision_dot (which owns the
+    Mosaic-safe precision rules), accumulate into VMEM scratch.
+    operand_dtypes is kept for signature stability; the precision decision
+    now reads the actual tile dtypes per call (statically identical)."""
 
     def accumulate(a_ref, b_ref, acc_ref, i, j, k):
         a = a_ref[:]
@@ -285,18 +306,7 @@ def _make_accumulate(
             else:
                 b = _global_tri_mask(b, r0, c0, b_uplo)
         dn = (((0 if a_trans else 1,), (1 if b_trans else 0,)), ((), ()))
-        if three_pass:
-            ah, al = _split_bf16(a)
-            bh, bl = _split_bf16(b)
-            dot = lambda x, y: jax.lax.dot_general(  # noqa: E731
-                x, y, dimension_numbers=dn, preferred_element_type=acc_dtype
-            )
-            acc_ref[:] += dot(ah, bh) + (dot(ah, bl) + dot(al, bh))
-        else:
-            acc_ref[:] += jax.lax.dot_general(
-                a, b, dimension_numbers=dn, preferred_element_type=acc_dtype,
-                precision=precision,
-            )
+        acc_ref[:] += precision_dot(a, b, dn, acc_dtype, precision)
 
     return accumulate
 

@@ -62,11 +62,15 @@ def _acc_dtype(dtype):
 
 
 def _dot(a, b, acc, *, trans_a=False, precision=None):
+    # the Mosaic-safe precision rules (bf16x3 for f32 'high', round-up,
+    # sub-f32 drop) live in ONE place: pallas_tpu.precision_dot.  The
+    # per-call bf16 split is O(bm·n) VPU work against O(bm·n²) of MXU
+    # flops (~0.1% of kernel time) — hoisting it out of the g-loop is
+    # deliberately not done.
+    from capital_tpu.ops.pallas_tpu import precision_dot
+
     dn = (((0 if trans_a else 1,), (0,)), ((), ()))
-    return jax.lax.dot_general(
-        a, b, dimension_numbers=dn,
-        preferred_element_type=acc, precision=precision,
-    )
+    return precision_dot(a, b, dn, acc, precision)
 
 
 def _pick_bm(m: int, preferred: int) -> int:

@@ -84,6 +84,25 @@ class TestKernels:
         Qs = qr_fused.scale_blocked(A, Rinv, bm=512, g=g)
         np.testing.assert_allclose(np.asarray(Qs), wantQ, rtol=1e-4, atol=1e-3)
 
+    def test_f32_precision_high_three_pass(self):
+        # precision='high' on f32 operands must take the in-kernel bf16x3
+        # split (Mosaic has no HIGH lowering — passing it through crashed
+        # with NotImplementedError on hardware) and land f32-grade results
+        A = _tall(1024, 512, key=13).astype(jnp.float32)
+        Gu = qr_fused.gram_blocked(A, bm=512, precision="high")
+        G = qr_fused.assemble_sym(Gu, 256)
+        want = np.asarray(A, np.float64).T @ np.asarray(A, np.float64)
+        np.testing.assert_allclose(
+            np.asarray(G), want, rtol=2e-4, atol=2e-3
+        )
+        # 3-pass must beat a 1-pass bf16 product by orders of magnitude
+        Gd = qr_fused.assemble_sym(qr_fused.gram_blocked(
+            A.astype(jnp.bfloat16).astype(jnp.float32), bm=512
+        ), 256)
+        err3 = np.max(np.abs(np.asarray(G) - want))
+        err1 = np.max(np.abs(np.asarray(Gd) - want))
+        assert err3 < err1 / 50, (err3, err1)
+
     def test_pick_g(self):
         assert qr_fused.pick_g(1024) == 8
         assert qr_fused.pick_g(2048) == 16  # 128-wide blocks still eligible

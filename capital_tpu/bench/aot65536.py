@@ -159,6 +159,27 @@ def _compile_and_measure(fn, shape):
     return mem, census
 
 
+# XLA's per-chip byte limit on v5e as it reports it (decimal GB: the
+# round-3 OOM messages read "Used 16.01G of 15.75G")
+HBM_V5E = 15.75e9
+
+
+def _gib(b):
+    return b / 1e9
+
+
+def _mem_table(mem, arg_label, out_label):
+    """The per-chip memory markdown table both witness artifacts share."""
+    return (
+        "| quantity | bytes | GB |\n"
+        "|---|---|---|\n"
+        f"| arguments ({arg_label}) | {mem['argument_bytes']} | {_gib(mem['argument_bytes']):.2f} |\n"
+        f"| outputs ({out_label}) | {mem['output_bytes']} | {_gib(mem['output_bytes']):.2f} |\n"
+        f"| temporaries | {mem['temp_bytes']} | {_gib(mem['temp_bytes']):.2f} |\n"
+        f"| **peak HBM** | **{mem['peak_memory_bytes']}** | **{_gib(mem['peak_memory_bytes']):.2f}** |"
+    )
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(prog="capital_tpu.bench.aot65536")
     p.add_argument("--alg", choices=["cholinv", "cacqr"], default="cholinv")
@@ -203,8 +224,7 @@ def _run_aot(args, grid, cfg, fn, shape, proj, n):
     }
     print(json.dumps(rec))
     if args.out:
-        hbm = 15.75e9
-        gib = lambda b: b / 1e9  # noqa: E731
+        hbm = HBM_V5E
         with open(args.out, "w") as f:
             f.write(
                 f"""# CQR2 {args.m}x{n} on v5e-8 — AOT-compiled witness (round 4)
@@ -227,12 +247,7 @@ regime='1d', num_iter=2.
 
 ## Per-chip memory (XLA buffer assignment, bytes are PER CHIP)
 
-| quantity | bytes | GB |
-|---|---|---|
-| arguments (X block) | {mem['argument_bytes']} | {gib(mem['argument_bytes']):.2f} |
-| outputs (Q block, R) | {mem['output_bytes']} | {gib(mem['output_bytes']):.2f} |
-| temporaries | {mem['temp_bytes']} | {gib(mem['temp_bytes']):.2f} |
-| **peak HBM** | **{mem['peak_memory_bytes']}** | **{gib(mem['peak_memory_bytes']):.2f}** |
+{_mem_table(mem, "X block", "Q block, R")}
 
 Peak = {100 * mem['peak_memory_bytes'] / hbm:.0f}% of a v5e chip's
 15.75 GB XLA byte limit — the 8-chip row fits with room to spare (the
@@ -284,10 +299,7 @@ def _run_cholinv_tail(args, grid, cfg, fn, shape, proj):
     }
     print(json.dumps(rec))
     if args.out:
-        # XLA's per-chip byte limit on v5e as it reports it (decimal GB:
-        # the round-3 OOM messages read "Used 16.01G of 15.75G")
-        hbm = 15.75e9
-        gib = lambda b: b / 1e9  # noqa: E731
+        hbm = HBM_V5E
         with open(args.out, "w") as f:
             f.write(
                 f"""# N=65536 on v5e-8 — AOT-compiled witness (round 4)
@@ -312,12 +324,7 @@ single-chip flagship runs, distributed.
 
 ## Per-chip memory (XLA buffer assignment, bytes are PER CHIP)
 
-| quantity | bytes | GB |
-|---|---|---|
-| arguments (A block) | {mem['argument_bytes']} | {gib(mem['argument_bytes']):.2f} |
-| outputs (R, R⁻¹ blocks) | {mem['output_bytes']} | {gib(mem['output_bytes']):.2f} |
-| temporaries | {mem['temp_bytes']} | {gib(mem['temp_bytes']):.2f} |
-| **peak HBM** | **{mem['peak_memory_bytes']}** | **{gib(mem['peak_memory_bytes']):.2f}** |
+{_mem_table(mem, "A block", "R, R⁻¹ blocks")}
 
 Peak = {100 * mem['peak_memory_bytes'] / hbm:.0f}% of a v5e chip's
 15.75 GB XLA byte limit — the program **fits**; the single-chip wall

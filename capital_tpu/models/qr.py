@@ -377,7 +377,7 @@ def factor(
         if (
             cfg.num_iter == 2
             and g
-            and qr_fused.fused_ok(grid, m, n, cfg.mode, g=g)
+            and qr_fused.fused_ok(grid, m, n, cfg.mode, g=g, dtype=A.dtype)
         ):
             return _cqr2_fused(grid, A, cfg, g)
         Q, R = _sweep_1d(grid, A, cfg)

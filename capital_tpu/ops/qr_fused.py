@@ -336,11 +336,24 @@ def assemble_sym(Gu: jnp.ndarray, c: int) -> jnp.ndarray:
     return Gu
 
 
-def fused_ok(grid, m: int, n: int, mode: str, bm: int = 1024, g: int = 2) -> bool:
-    """Can the fused CQR2 pipeline run?  Single-device pallas mode plus the
-    shared kernel eligibility rule (_eligible)."""
-    return (
-        mode == "pallas"
-        and grid.num_devices == 1
-        and _eligible(m, n, bm, g) != 0
-    )
+def fused_ok(grid, m: int, n: int, mode: str, bm: int = 1024, g: int = 2,
+             *, dtype) -> bool:
+    """Can the fused CQR2 pipeline run?  Single-device pallas mode, the
+    shared kernel eligibility rule (_eligible), and the VMEM envelope:
+    scale_gram holds an (bm, n) A block, the (n, n) Rinv, an (bm, n) Q
+    block and the f32 (n, n) gram resident at once — at n=4096 bf16 that
+    is ~112 MB before Mosaic's own overheads and the compile fails with a
+    vmem OOM ("Used 143.69M of 128.00M"), so wide-n shapes fall back to
+    the unfused blocked sweeps instead of crashing."""
+    bm_ok = _eligible(m, n, bm, g)
+    if not (mode == "pallas" and grid.num_devices == 1 and bm_ok):
+        return False
+    if _interpret_default():
+        # interpret mode has no VMEM: applying the hardware envelope here
+        # would route the CPU test rig differently from v5e (fused wide-n
+        # coverage would silently vanish from CI)
+        return True
+    item = jnp.dtype(dtype).itemsize
+    resident = 2 * bm_ok * n * item + n * n * (item + 4)
+    limit = _device_budget()[1] or (16 << 20)
+    return resident <= 0.85 * limit

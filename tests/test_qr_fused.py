@@ -119,17 +119,17 @@ class TestKernels:
         with pytest.raises(ValueError):
             qr_fused.gram_blocked(A, bm=512)
         g1 = Grid.square(c=1, devices=jax.devices("cpu")[:1])
-        assert not qr_fused.fused_ok(g1, 1000, 512, "pallas")
-        assert not qr_fused.fused_ok(g1, 1024, 192, "pallas")  # no g=2 split
-        assert not qr_fused.fused_ok(g1, 1024, 512, "xla")
-        assert qr_fused.fused_ok(g1, 1024, 512, "pallas")
+        assert not qr_fused.fused_ok(g1, 1000, 512, "pallas", dtype=jnp.float32)
+        assert not qr_fused.fused_ok(g1, 1024, 192, "pallas", dtype=jnp.float32)  # no g=2 split
+        assert not qr_fused.fused_ok(g1, 1024, 512, "xla", dtype=jnp.float32)
+        assert qr_fused.fused_ok(g1, 1024, 512, "pallas", dtype=jnp.float32)
 
 
 class TestFusedPipeline:
     def test_fused_cqr2_matches_unfused(self, grid1):
         A = _tall(2048, 512).astype(jnp.float64)
         fused_cfg = CacqrConfig(num_iter=2, regime="1d", mode="pallas")
-        assert qr_fused.fused_ok(grid1, *A.shape, "pallas")
+        assert qr_fused.fused_ok(grid1, *A.shape, "pallas", dtype=A.dtype)
         Qf, Rf = jax.jit(lambda a: qr.factor(grid1, a, fused_cfg))(A)
         # unfused reference: xla mode takes the separate-pass pipeline
         Qu, Ru = jax.jit(

@@ -104,13 +104,50 @@ def test_qr_and_rectri_scoped_with_tpu_default(tpu_default_backend):
     assert float(residual.inverse_residual(T, Tinv)) < 1e-4
 
 
+def _load_graft_entry():
+    path = pathlib.Path(__file__).resolve().parent.parent / "__graft_entry__.py"
+    spec = importlib.util.spec_from_file_location("graft_entry_for_test", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_dryrun_hermetic_no_default_backend_execution(
+    tpu_default_backend, monkeypatch
+):
+    """Round-4 MULTICHIP regression: rc=1 because eager ops in the dryrun
+    (the residual-gate block's mask constants) dispatched on the *process
+    default* backend, which was a TPU with a libtpu client/terminal version
+    skew.  Simulate exactly that: default-backend *resolution* for execution
+    raises (as the skewed TPU client did), while explicit-platform lookups
+    and the device listing still work (they did in the real environment —
+    ``jax.devices()`` returned the TPU fine; only executing on it died).
+    The dryrun must survive because ``jax.default_device`` pins every
+    uncommitted eager op to the mesh's own devices."""
+    import jax._src.xla_bridge as xb
+
+    mod = _load_graft_entry()
+    cpu_devices = jax.devices("cpu")
+    real_get_backend = xb.get_backend
+
+    def broken_default_backend(platform=None):
+        if platform is None:
+            raise RuntimeError(
+                "SIMULATED FAILED_PRECONDITION: libtpu version mismatch "
+                "(process-default backend touched by the dryrun)"
+            )
+        return real_get_backend(platform)
+
+    # the dryrun's own device listing is allowed (it worked in the real
+    # failure env); execution-time default-backend resolution is not
+    monkeypatch.setattr(mod.jax, "devices", lambda *a: cpu_devices)
+    monkeypatch.setattr(xb, "get_backend", broken_default_backend)
+    mod.dryrun_multichip(8)
+
+
 def test_dryrun_multichip_runs_end_to_end(tpu_default_backend):
     # the driver imports __graft_entry__ and calls dryrun_multichip(N)
     # directly (the __main__ platform guard never runs) — do the same,
     # under the simulated TPU default backend so every kernel-dispatch
     # decision in the dryrun call tree is exercised in the mixed environment
-    path = pathlib.Path(__file__).resolve().parent.parent / "__graft_entry__.py"
-    spec = importlib.util.spec_from_file_location("graft_entry_for_test", path)
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    mod.dryrun_multichip(8)
+    _load_graft_entry().dryrun_multichip(8)

@@ -137,8 +137,18 @@ def main(argv=None) -> None:
             checkpoint=args.resume, **space,
         )
     elif args.alg == "trsm":
-        if "grids" in space:
-            p.error("--grids is not a trsm sweep axis (bc x leaf x mode only)")
+        # reject every non-axis rather than silently ignoring it (ADVICE r4:
+        # a sweep with --splits would report results that don't reflect it)
+        for flag, given in (
+            ("--grids", "grids" in space),
+            ("--splits", bool(args.splits)),
+            ("--policies", bool(args.policies)),
+            ("--top-k", args.top_k != 0),
+            ("--layouts", bool(args.layouts)),
+            ("--chunks", bool(args.chunks)),
+        ):
+            if given:
+                p.error(f"{flag} is not a trsm sweep axis (bc x leaf x mode only)")
         if args.modes:
             space["modes"] = tuple(args.modes)
         grid = Grid.square(c=1, devices=dev)

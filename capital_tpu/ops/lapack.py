@@ -128,7 +128,11 @@ def trtri_stack(
     # products — the upcast buys nothing if the matmuls drop back to
     # 1-pass bf16.
     ct = _compute_dtype(D.dtype)
-    if jnp.dtype(D.dtype).itemsize < 4:
+    if precision is None:
+        # never let the merge products run at TPU-default (one-pass bf16)
+        # grade — that silently degrades the block inverses below what the
+        # plain batched trtri delivers (ADVICE r4).  Callers wanting speed
+        # over accuracy must opt in explicitly.
         precision = "highest"
     Dm = jnp.tril(D).astype(ct)
 

@@ -44,8 +44,10 @@ from __future__ import annotations
 
 import dataclasses
 
+import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.sharding import PartitionSpec as P
 
 from capital_tpu.models import cholesky
 from capital_tpu.models.cholesky import CholinvConfig
@@ -260,6 +262,90 @@ def _cqr2_fused(
     return Q, R
 
 
+def _cqr2_fused_sharded(
+    grid: Grid, A: jnp.ndarray, cfg: CacqrConfig, g: int
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """The fused CQR2 pipeline on a mesh: the SAME Mosaic kernels, run PER
+    SHARD inside one shard_map over the row-sharded operand (VERDICT r4 #2
+    — the reference gets its local-BLAS flop saving on every rank,
+    blas/interface.hpp:74-97; here every chip runs the fused tall-pass
+    kernels on its own m/p rows).  Mosaic custom calls cannot be GSPMD-
+    partitioned (the round-4 AOT finding), but inside shard_map the
+    partitioning is manual — each shard's kernel call is a single-device
+    program, so the same `vma`-annotated kernels compile for the 8-chip
+    topology (witnessed by bench.aot65536 --alg cacqr).
+
+    Per shard:  G1 += psum(gram(A_loc));  chol+inv replicated;
+    (Q1_loc, G2_part) = scale_gram(A_loc, R1inv);  G2 = psum;  chol+inv;
+    Q_loc = scale_blocked(Q1_loc, R2inv);  R = R2·R1.  The two psums are
+    the pipeline's ONLY collectives — identical to the unfused 1d tree
+    (reference MPI_Allreduce over world, cacqr.hpp:14-25)."""
+    from capital_tpu.ops import qr_fused
+
+    m, n = A.shape
+    c = n // g
+    p = grid.num_devices
+    precision = cfg.precision
+    live = qr_fused.live_fraction(g)
+    axes = ("x", "y", "z")
+
+    def body(a_loc):
+        # trace-time emissions run once, inside the body: all quantities
+        # are already per-device (the Recorder's convention — _sweep_1d
+        # divides global flops by num_devices to land at the same figures)
+        m_loc = a_loc.shape[0]
+        comm, ncoll = tracing.allreduce_cost(grid, n, n, jnp.float32, axes="all")
+        with tracing.scope("CQR::gram"):
+            tracing.emit(
+                flops=2.0 * m_loc * n * n * live, comm_bytes=comm,
+                collectives=ncoll,
+            )
+            G1u = lax.psum(
+                qr_fused.gram_blocked(a_loc, g=g, precision=precision), axes
+            )
+            G1 = qr_fused.assemble_sym(G1u, c).astype(A.dtype)
+        with tracing.scope("CQR::chol"):
+            tracing.emit(flops=tracing.potrf_trtri_flops(n))
+            R1, R1inv = lapack.potrf_trtri(G1, uplo="U")
+        with tracing.scope("CQR::fused"):
+            tracing.emit(
+                flops=2.0 * m_loc * n * n * (live + live), comm_bytes=comm,
+                collectives=ncoll,
+            )
+            Q1, G2u = qr_fused.scale_gram(
+                a_loc, jnp.triu(R1inv), g=g, precision=precision
+            )
+            G2 = qr_fused.assemble_sym(lax.psum(G2u, axes), c).astype(A.dtype)
+        with tracing.scope("CQR::chol"):
+            tracing.emit(flops=tracing.potrf_trtri_flops(n))
+            R2, R2inv = lapack.potrf_trtri(G2, uplo="U")
+        with tracing.scope("CQR::formR"):
+            tracing.emit(flops=2.0 * m_loc * n * n * live)
+            Q = qr_fused.scale_blocked(
+                Q1, jnp.triu(R2inv), g=g, precision=precision
+            )
+        with tracing.scope("CQR::merge"):
+            tracing.emit(flops=2.0 * n**3)
+            R = jnp.matmul(jnp.triu(R2), jnp.triu(R1), precision=precision)
+        return Q, R
+
+    # check_vma=False: pallas's interpret-mode evaluator (the CPU test rig)
+    # builds its grid-carry init with empty varying-axes and trips the vma
+    # matcher against the per-shard operands — an interpreter limitation,
+    # not a replication hazard: R is computed identically on every shard
+    # from psum'd grams (gated by the mesh tests' residual checks), and the
+    # Mosaic path also compiles under check_vma=True (the vma-annotated
+    # out_shapes stay for that).
+    Q, R = jax.shard_map(
+        body,
+        mesh=grid.mesh,
+        in_specs=P(axes, None),
+        out_specs=(P(axes, None), P()),
+        check_vma=False,
+    )(lax.with_sharding_constraint(A, grid.rows_sharding()))
+    return Q, R
+
+
 def _sweep_dist(
     grid: Grid, A: jnp.ndarray, cfg: CacqrConfig
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
@@ -379,6 +465,8 @@ def factor(
             and g
             and qr_fused.fused_ok(grid, m, n, cfg.mode, g=g, dtype=A.dtype)
         ):
+            if grid.num_devices > 1:
+                return _cqr2_fused_sharded(grid, A, cfg, g)
             return _cqr2_fused(grid, A, cfg, g)
         Q, R = _sweep_1d(grid, A, cfg)
         if cfg.num_iter == 2:

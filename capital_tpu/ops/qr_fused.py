@@ -49,7 +49,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from capital_tpu.ops.pallas_tpu import _device_budget, _interpret_default, _platform
+from capital_tpu.ops.pallas_tpu import (
+    _device_budget,
+    _interpret_default,
+    _platform,
+    platform_scope,
+)
 
 
 def _acc_dtype(dtype):
@@ -71,6 +76,19 @@ def _dot(a, b, acc, *, trans_a=False, precision=None):
 
     dn = (((0 if trans_a else 1,), (0,)), ((), ()))
     return precision_dot(a, b, dn, acc, precision)
+
+
+def _out_struct(shape, dtype, *operands):
+    """Out-shape struct carrying the union of the operands' varying mesh
+    axes: pallas_call outputs inside a shard_map body must declare their
+    vma under replication checking (check_vma) — outside shard_map the vma
+    set is empty and this is a plain ShapeDtypeStruct."""
+    vma: frozenset = frozenset()
+    for r in operands:
+        vma |= jax.typeof(r).vma
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
 
 
 def _pick_bm(m: int, preferred: int) -> int:
@@ -166,7 +184,7 @@ def gram_blocked(
             pl.BlockSpec((bm, n), lambda i: (i, 0), memory_space=pltpu.VMEM)
         ],
         out_specs=pl.BlockSpec((n, n), lambda i: (0, 0), memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((n, n), acc),
+        out_shape=_out_struct((n, n), acc, A),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",),
             vmem_limit_bytes=_device_budget()[1],
@@ -249,8 +267,8 @@ def scale_gram(
             pl.BlockSpec((n, n), lambda i: (0, 0), memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((m, n), A.dtype),
-            jax.ShapeDtypeStruct((n, n), acc),
+            _out_struct((m, n), A.dtype, A, Rinv),
+            _out_struct((n, n), acc, A, Rinv),
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",),
@@ -312,7 +330,7 @@ def scale_blocked(
             pl.BlockSpec((n, n), lambda i: (0, 0), memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((bm, n), lambda i: (i, 0), memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((m, n), A.dtype),
+        out_shape=_out_struct((m, n), A.dtype, A, Rinv),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",),
             vmem_limit_bytes=_device_budget()[1],
@@ -338,22 +356,31 @@ def assemble_sym(Gu: jnp.ndarray, c: int) -> jnp.ndarray:
 
 def fused_ok(grid, m: int, n: int, mode: str, bm: int = 1024, g: int = 2,
              *, dtype) -> bool:
-    """Can the fused CQR2 pipeline run?  Single-device pallas mode, the
-    shared kernel eligibility rule (_eligible), and the VMEM envelope:
-    scale_gram holds an (bm, n) A block, the (n, n) Rinv, an (bm, n) Q
-    block and the f32 (n, n) gram resident at once — at n=4096 bf16 that
-    is ~112 MB before Mosaic's own overheads and the compile fails with a
-    vmem OOM ("Used 143.69M of 128.00M"), so wide-n shapes fall back to
-    the unfused blocked sweeps instead of crashing."""
-    bm_ok = _eligible(m, n, bm, g)
-    if not (mode == "pallas" and grid.num_devices == 1 and bm_ok):
+    """Can the fused CQR2 pipeline run?  Pallas mode, the shared kernel
+    eligibility rule (_eligible) applied to the PER-SHARD row extent (on a
+    mesh the kernels run per shard inside shard_map — models/qr.py
+    _cqr2_fused_sharded — so eligibility is about each device's m/p rows),
+    and the VMEM envelope: scale_gram holds an (bm, n) A block, the (n, n)
+    Rinv, an (bm, n) Q block and the f32 (n, n) gram resident at once — at
+    n=4096 bf16 that is ~112 MB before Mosaic's own overheads and the
+    compile fails with a vmem OOM ("Used 143.69M of 128.00M"), so wide-n
+    shapes fall back to the unfused blocked sweeps instead of crashing."""
+    p = grid.num_devices
+    if p > 1 and m % p:
+        return False  # shard_map needs the row axis to divide evenly
+    bm_ok = _eligible(m // p, n, bm, g)
+    if not (mode == "pallas" and bm_ok):
         return False
-    if _interpret_default():
-        # interpret mode has no VMEM: applying the hardware envelope here
-        # would route the CPU test rig differently from v5e (fused wide-n
-        # coverage would silently vanish from CI)
-        return True
-    item = jnp.dtype(dtype).itemsize
-    resident = 2 * bm_ok * n * item + n * n * (item + 4)
-    limit = _device_budget()[1] or (16 << 20)
-    return resident <= 0.85 * limit
+    # resolve interpret/VMEM against the GRID's platform, not the process
+    # default: callers outside a scoped entry point (e.g. the multichip
+    # dryrun probing eligibility) must not touch the default backend
+    with platform_scope(getattr(grid, "platform", None)):
+        if _interpret_default():
+            # interpret mode has no VMEM: applying the hardware envelope
+            # here would route the CPU test rig differently from v5e (fused
+            # wide-n coverage would silently vanish from CI)
+            return True
+        item = jnp.dtype(dtype).itemsize
+        resident = 2 * bm_ok * n * item + n * n * (item + 4)
+        limit = _device_budget()[1] or (16 << 20)
+        return resident <= 0.85 * limit

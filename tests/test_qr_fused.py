@@ -149,8 +149,9 @@ class TestFusedPipeline:
         assert float(residual.qr_orthogonality(Q)) < 5e-2
         assert float(residual.qr_residual(A, Q, R)) < 5e-2
 
-    def test_cqr1_and_multidevice_stay_unfused(self, grid_flat8, grid1):
-        # num_iter=1 and mesh grids must keep the existing paths
+    def test_cqr1_stays_unfused_and_mesh_gates_hold(self, grid_flat8, grid1):
+        # num_iter=1 keeps the sweep pipeline; on the mesh the per-shard
+        # kernels engage (128-row shards pick bm=128) and must still gate
         A = _tall(1024, 512).astype(jnp.float64)
         cfg1 = CacqrConfig(num_iter=1, regime="1d", mode="pallas")
         Q, R = qr.factor(grid1, A, cfg1)
@@ -159,3 +160,53 @@ class TestFusedPipeline:
         cfgm = CacqrConfig(num_iter=2, regime="1d", mode="pallas")
         Qm, Rm = jax.jit(lambda a: qr.factor(grid_flat8, a, cfgm))(Ad)
         assert float(residual.qr_orthogonality(Qm)) < 1e-13
+
+
+class TestFusedSharded:
+    """The per-shard fused pipeline on a mesh (qr._cqr2_fused_sharded):
+    same kernels, run inside shard_map with the grams psum-merged
+    (VERDICT r4 #2 — the reference's per-rank local-BLAS saving,
+    blas/interface.hpp:74-97)."""
+
+    def test_sharded_matches_single_device(self, grid_flat8, grid1):
+        m, n = 4096, 512  # 512 rows per shard: per-shard eligible
+        A = _tall(m, n).astype(jnp.float64)
+        cfg = CacqrConfig(num_iter=2, regime="1d", mode="pallas")
+        g = qr_fused.pick_g(n)
+        assert qr_fused.fused_ok(grid_flat8, m, n, "pallas", g=g, dtype=A.dtype)
+        Ad = jax.device_put(A, grid_flat8.rows_sharding())
+        Qm, Rm = jax.jit(lambda a: qr.factor(grid_flat8, a, cfg))(Ad)
+        Q1, R1 = jax.jit(lambda a: qr.factor(grid1, a, cfg))(A)
+        assert float(residual.qr_orthogonality(Qm)) < 1e-14
+        assert float(residual.qr_residual(Ad, Qm, Rm)) < 1e-13
+        # identical math up to the psum's reduction association order
+        np.testing.assert_allclose(np.asarray(Qm), np.asarray(Q1), atol=1e-10)
+        np.testing.assert_allclose(
+            np.triu(np.asarray(Rm)), np.triu(np.asarray(R1)), atol=1e-8
+        )
+
+    def test_sharded_bf16_gates(self, grid_flat8):
+        m, n = 4096, 512
+        A = _tall(m, n, key=3).astype(jnp.bfloat16)
+        Ad = jax.device_put(A, grid_flat8.rows_sharding())
+        cfg = CacqrConfig(num_iter=2, regime="1d", mode="pallas")
+        Q, R = jax.jit(lambda a: qr.factor(grid_flat8, a, cfg))(Ad)
+        assert float(residual.qr_orthogonality(Q)) < 5e-2
+        assert float(residual.qr_residual(Ad, Q, R)) < 5e-2
+
+    def test_uneven_rows_fall_back_to_sweeps(self, grid_flat8):
+        # m not divisible by the device count: the m % p guard must refuse
+        # (4100 % 8 = 4 — hits the guard itself, not the bm-tiling rule)
+        # and the factor must still produce a correct result via the sweeps
+        m, n = 4100, 512
+        assert not qr_fused.fused_ok(
+            grid_flat8, m, n, "pallas", dtype=jnp.float64
+        )
+        # uneven rows cannot even be device_put row-sharded (NamedSharding
+        # demands divisibility); the factor's in-jit constraint handles the
+        # placement, exactly how an uneven caller would reach it
+        A = _tall(m, n).astype(jnp.float64)
+        cfg = CacqrConfig(num_iter=2, regime="1d", mode="pallas")
+        Q, R = jax.jit(lambda a: qr.factor(grid_flat8, a, cfg))(A)
+        assert float(residual.qr_orthogonality(Q)) < 1e-13
+        assert float(residual.qr_residual(A, Q, R)) < 1e-13

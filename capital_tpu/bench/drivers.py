@@ -253,6 +253,7 @@ def cacqr(args) -> dict:
             base_case_dim=args.bc, mode=mode, precision=precision
         ),
         precision=precision,
+        fused_g=getattr(args, "fused_g", 0),
     )
     # One-shot regen protocol when the A-carry would not fit: the standard
     # loop keeps FOUR Q-sized buffers at peak (A carry, Q1, Q, and the
@@ -262,7 +263,7 @@ def cacqr(args) -> dict:
     # buffers, putting the 8-rank BASELINE shape on ONE chip.  Requires
     # the element-coupling eligibility (qr.pallas_coupled) — the one-shot
     # consume is a one-element read.
-    elem_ok = qr.pallas_coupled(grid, args.n, mode)
+    elem_ok = qr.pallas_coupled(grid, args.n, mode, m=args.m, dtype=dtype)
     oneshot = (
         elem_ok
         and grid.num_devices == 1
@@ -627,6 +628,11 @@ def build_parser() -> argparse.ArgumentParser:
         "drift guard; on by default under the suite driver on TPU",
     )
     p.add_argument("--newton-iters", type=int, default=30)
+    p.add_argument(
+        "--fused-g", type=int, default=0,
+        help="cacqr: in-kernel column split of the fused tall-pass kernels "
+        "(0 = auto, qr_fused.pick_g)",
+    )
     p.add_argument(
         "--leaf", default="invert", choices=["invert", "solve"],
         help="trsm leaf policy (TrsmConfig.leaf)",

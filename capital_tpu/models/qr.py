@@ -220,7 +220,7 @@ def _sweep_1d(
 
 
 def _cqr2_fused(
-    grid: Grid, A: jnp.ndarray, cfg: CacqrConfig, g: int
+    grid: Grid, A: jnp.ndarray, cfg: CacqrConfig, g: int, plan: str = "full"
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """CQR2 through the fused tall-pass kernels (ops/qr_fused.py): sweep 1's
     gram in one A read, sweep 1's scale and sweep 2's gram in one shared
@@ -230,29 +230,72 @@ def _cqr2_fused(
     (executed flops (g+1)/2g of dense at zero extra HBM — VERDICT r3 #1).
     Numerically the same pipeline as two _sweep_1d calls (grams from the
     rounded Q, f32 accumulation) up to reduction association order.
-    Single-device pallas mode only (qr_fused.fused_ok)."""
+    `plan` picks the tier (qr_fused.fused_plan): 'full' shares sweep 1's
+    scale and sweep 2's gram in one scale_gram pass; 'split' (wide n) runs
+    them as two kernels to stay inside the per-kernel VMEM envelopes."""
     from capital_tpu.ops import qr_fused
 
     m, n = A.shape
-    c = n // g
     precision = cfg.precision
     live = qr_fused.live_fraction(g)
+
+    # wide grams: the whole-matrix lax chol+solve serializes its panel
+    # sweep (measured 10.7 ms at n=4096 ≈ 17 TF/s); the framework's own
+    # recursive cholinv with the live-tile kernels is the faster factor
+    # above the lax crossover (same single-chip pallas family the flagship
+    # runs).  cholinv reads ONLY the upper triangle (its potrf_trtri_upper
+    # base-case contract, verified bit-identical under a garbage lower
+    # half), so the gram can skip assemble_sym entirely — the kernel's
+    # upper-block-row form already holds the valid upper triangle.
+    use_cholinv = n >= 2048 and grid.num_devices == 1
+
+    def _chol(G):
+        if use_cholinv:
+            # the caller's nested cholinv config (drivers wire --bc into
+            # it) with this pipeline's mode/precision — not a parallel
+            # hardcoded config that would leave the knob dead
+            return cholesky.factor(
+                grid,
+                G,
+                dataclasses.replace(
+                    cfg.cholinv, mode=cfg.mode, precision=precision
+                ),
+            )
+        # upper-valid factor pair: reads only the triangle the gram kernel
+        # wrote, so no assembly pass is needed on this branch either
+        return lapack.potrf_trtri_upper(G)
+
+    def _gram_out(Gu):
+        # both chol routes read only the valid upper triangle — the
+        # symmetric assembly pass (n² of block transposes + re-layout,
+        # ~3 ms/iter inside the gram scopes at n=4096) is never needed
+        return Gu.astype(A.dtype)
+
     with tracing.scope("CQR::gram"):
         tracing.emit(flops=2.0 * m * n * n * live)
-        G1 = qr_fused.assemble_sym(
-            qr_fused.gram_blocked(A, g=g, precision=precision), c
-        ).astype(A.dtype)
+        G1 = _gram_out(qr_fused.gram_blocked(A, g=g, precision=precision))
     with tracing.scope("CQR::chol"):
         tracing.emit(flops=tracing.potrf_trtri_flops(n))
-        R1, R1inv = lapack.potrf_trtri(G1, uplo="U")
+        R1, R1inv = _chol(G1)
     with tracing.scope("CQR::fused"):
-        # scale1 (live) + gram2 (live) sharing one read of A
+        # scale1 (live) + gram2 (live): one shared read of A on the 'full'
+        # tier; the wide-n 'split' tier runs them as two kernels (sweep 2's
+        # gram re-reads the written Q1 — one extra HBM pass, every in-kernel
+        # flop saving kept; see qr_fused.fused_plan)
         tracing.emit(flops=2.0 * m * n * n * (live + live))
-        Q1, G2 = qr_fused.scale_gram(A, jnp.triu(R1inv), g=g, precision=precision)
-        G2 = qr_fused.assemble_sym(G2, c).astype(A.dtype)
+        if plan == "split":
+            Q1 = qr_fused.scale_blocked(
+                A, jnp.triu(R1inv), g=g, precision=precision
+            )
+            G2 = qr_fused.gram_blocked(Q1, g=g, precision=precision)
+        else:
+            Q1, G2 = qr_fused.scale_gram(
+                A, jnp.triu(R1inv), g=g, precision=precision
+            )
+        G2 = _gram_out(G2)
     with tracing.scope("CQR::chol"):
         tracing.emit(flops=tracing.potrf_trtri_flops(n))
-        R2, R2inv = lapack.potrf_trtri(G2, uplo="U")
+        R2, R2inv = _chol(G2)
     with tracing.scope("CQR::formR"):
         tracing.emit(flops=2.0 * m * n * n * live)
         Q = qr_fused.scale_blocked(Q1, jnp.triu(R2inv), g=g, precision=precision)
@@ -263,7 +306,7 @@ def _cqr2_fused(
 
 
 def _cqr2_fused_sharded(
-    grid: Grid, A: jnp.ndarray, cfg: CacqrConfig, g: int
+    grid: Grid, A: jnp.ndarray, cfg: CacqrConfig, g: int, plan: str = "full"
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """The fused CQR2 pipeline on a mesh: the SAME Mosaic kernels, run PER
     SHARD inside one shard_map over the row-sharded operand (VERDICT r4 #2
@@ -282,9 +325,7 @@ def _cqr2_fused_sharded(
     (reference MPI_Allreduce over world, cacqr.hpp:14-25)."""
     from capital_tpu.ops import qr_fused
 
-    m, n = A.shape
-    c = n // g
-    p = grid.num_devices
+    n = A.shape[1]
     precision = cfg.precision
     live = qr_fused.live_fraction(g)
     axes = ("x", "y", "z")
@@ -303,22 +344,31 @@ def _cqr2_fused_sharded(
             G1u = lax.psum(
                 qr_fused.gram_blocked(a_loc, g=g, precision=precision), axes
             )
-            G1 = qr_fused.assemble_sym(G1u, c).astype(A.dtype)
+            # the psum'd gram keeps the kernel's upper-block-row validity;
+            # the upper-valid factor pair reads only that triangle, so no
+            # per-shard symmetric assembly pass (same rule as _cqr2_fused)
+            G1 = G1u.astype(A.dtype)
         with tracing.scope("CQR::chol"):
             tracing.emit(flops=tracing.potrf_trtri_flops(n))
-            R1, R1inv = lapack.potrf_trtri(G1, uplo="U")
+            R1, R1inv = lapack.potrf_trtri_upper(G1)
         with tracing.scope("CQR::fused"):
             tracing.emit(
                 flops=2.0 * m_loc * n * n * (live + live), comm_bytes=comm,
                 collectives=ncoll,
             )
-            Q1, G2u = qr_fused.scale_gram(
-                a_loc, jnp.triu(R1inv), g=g, precision=precision
-            )
-            G2 = qr_fused.assemble_sym(lax.psum(G2u, axes), c).astype(A.dtype)
+            if plan == "split":
+                Q1 = qr_fused.scale_blocked(
+                    a_loc, jnp.triu(R1inv), g=g, precision=precision
+                )
+                G2u = qr_fused.gram_blocked(Q1, g=g, precision=precision)
+            else:
+                Q1, G2u = qr_fused.scale_gram(
+                    a_loc, jnp.triu(R1inv), g=g, precision=precision
+                )
+            G2 = lax.psum(G2u, axes).astype(A.dtype)
         with tracing.scope("CQR::chol"):
             tracing.emit(flops=tracing.potrf_trtri_flops(n))
-            R2, R2inv = lapack.potrf_trtri(G2, uplo="U")
+            R2, R2inv = lapack.potrf_trtri_upper(G2)
         with tracing.scope("CQR::formR"):
             tracing.emit(flops=2.0 * m_loc * n * n * live)
             Q = qr_fused.scale_blocked(
@@ -420,16 +470,33 @@ def solve_blocked(
 # --------------------------------------------------------------------------
 
 
-def pallas_coupled(grid: Grid, n: int, mode: str) -> bool:
+def pallas_coupled(
+    grid: Grid, n: int, mode: str, m: int | None = None, dtype=None
+) -> bool:
     """True when a 1d factor's outputs ride ops XLA cannot slice into (Q
     through pallas custom calls — the blocked/fused kernels engaged — and R
     through a whole-input potrf chain), making a one-element benchmark
     carry measurement-safe (harness.timed_loop coupling='elem').  Lives
     HERE, next to the kernel gating it mirrors (_sweep_1d's tri_kernel +
-    qr_fused.fused_ok): if the routing changes, this predicate must change
-    with it — a stale copy in a driver would let the simplifier silently
-    narrow the measured work."""
-    return mode == "pallas" and grid.num_devices == 1 and _col_blocks(n) > 1
+    qr_fused.fused_plan): if the routing changes, this predicate must
+    change with it — a stale copy in a driver would let the simplifier
+    silently narrow the measured work.
+
+    On a mesh the per-shard fused pipeline (round 5) is the only pallas
+    route; deciding it needs the full (m, dtype) question — callers that
+    cannot supply them get the conservative False (full-consumption
+    coupling is always measurement-safe, just slower)."""
+    if grid.num_devices == 1:
+        return mode == "pallas" and _col_blocks(n) > 1
+    if m is None or dtype is None:
+        return False
+    from capital_tpu.ops import qr_fused
+
+    g = qr_fused.pick_g(n)
+    return bool(
+        g
+        and qr_fused.fused_plan(grid, m, n, mode, g=g, dtype=dtype) is not None
+    )
 
 
 def _pick_regime(grid: Grid, n: int, cfg: CacqrConfig) -> str:
@@ -460,14 +527,15 @@ def factor(
         from capital_tpu.ops import qr_fused
 
         g = qr_fused.pick_g(n, cfg.fused_g)
-        if (
-            cfg.num_iter == 2
-            and g
-            and qr_fused.fused_ok(grid, m, n, cfg.mode, g=g, dtype=A.dtype)
-        ):
+        plan = (
+            qr_fused.fused_plan(grid, m, n, cfg.mode, g=g, dtype=A.dtype)
+            if cfg.num_iter == 2 and g
+            else None
+        )
+        if plan:
             if grid.num_devices > 1:
-                return _cqr2_fused_sharded(grid, A, cfg, g)
-            return _cqr2_fused(grid, A, cfg, g)
+                return _cqr2_fused_sharded(grid, A, cfg, g, plan)
+            return _cqr2_fused(grid, A, cfg, g, plan)
         Q, R = _sweep_1d(grid, A, cfg)
         if cfg.num_iter == 2:
             Q, R2 = _sweep_1d(grid, Q, cfg)

@@ -354,23 +354,36 @@ def assemble_sym(Gu: jnp.ndarray, c: int) -> jnp.ndarray:
     return Gu
 
 
-def fused_ok(grid, m: int, n: int, mode: str, bm: int = 1024, g: int = 2,
-             *, dtype) -> bool:
-    """Can the fused CQR2 pipeline run?  Pallas mode, the shared kernel
-    eligibility rule (_eligible) applied to the PER-SHARD row extent (on a
-    mesh the kernels run per shard inside shard_map — models/qr.py
-    _cqr2_fused_sharded — so eligibility is about each device's m/p rows),
-    and the VMEM envelope: scale_gram holds an (bm, n) A block, the (n, n)
-    Rinv, an (bm, n) Q block and the f32 (n, n) gram resident at once — at
-    n=4096 bf16 that is ~112 MB before Mosaic's own overheads and the
-    compile fails with a vmem OOM ("Used 143.69M of 128.00M"), so wide-n
-    shapes fall back to the unfused blocked sweeps instead of crashing."""
+def fused_plan(grid, m: int, n: int, mode: str, bm: int = 1024, g: int = 2,
+               *, dtype) -> str | None:
+    """Which fused CQR2 pipeline can run?  Returns
+
+      'full'  — the three-kernel pipeline with scale_gram (sweep 1's scale
+                and sweep 2's gram share one pass; 5 HBM passes total);
+      'split' — the wide-n streaming tier (round 5, VERDICT r4 #3):
+                scale_gram's envelope (A block + Rinv + Q block + f32 gram
+                all VMEM-resident, ~112 MB at n=4096 bf16 — a compile-time
+                vmem OOM) is exceeded, but gram_blocked's (one row block +
+                the gram) and scale_blocked's (two row blocks + Rinv) still
+                fit, so sweep 2's gram runs as its own gram_blocked pass
+                over the written Q1.  Costs ONE extra read of Q1 (6 passes
+                instead of 5) and keeps every in-kernel g-way flop saving —
+                at wide n the pipeline is MXU-bound (arithmetic intensity
+                ~n/6 flops/byte), so the extra pass is noise next to the
+                (g+1)/2g executed-flop drop;
+      None    — fall back to the unfused blocked sweeps.
+
+    Gating: pallas mode, the shared kernel eligibility rule (_eligible)
+    applied to the PER-SHARD row extent (on a mesh the kernels run per
+    shard inside shard_map — models/qr.py _cqr2_fused_sharded — so
+    eligibility is about each device's m/p rows), and the per-kernel VMEM
+    envelopes above."""
     p = grid.num_devices
     if p > 1 and m % p:
-        return False  # shard_map needs the row axis to divide evenly
+        return None  # shard_map needs the row axis to divide evenly
     bm_ok = _eligible(m // p, n, bm, g)
     if not (mode == "pallas" and bm_ok):
-        return False
+        return None
     # resolve interpret/VMEM against the GRID's platform, not the process
     # default: callers outside a scoped entry point (e.g. the multichip
     # dryrun probing eligibility) must not touch the default backend
@@ -379,8 +392,19 @@ def fused_ok(grid, m: int, n: int, mode: str, bm: int = 1024, g: int = 2,
             # interpret mode has no VMEM: applying the hardware envelope
             # here would route the CPU test rig differently from v5e (fused
             # wide-n coverage would silently vanish from CI)
-            return True
+            return "full"
         item = jnp.dtype(dtype).itemsize
-        resident = 2 * bm_ok * n * item + n * n * (item + 4)
-        limit = _device_budget()[1] or (16 << 20)
-        return resident <= 0.85 * limit
+        limit = 0.85 * (_device_budget()[1] or (16 << 20))
+        if 2 * bm_ok * n * item + n * n * (item + 4) <= limit:
+            return "full"
+        gram_res = bm_ok * n * item + 4 * n * n
+        scale_res = 2 * bm_ok * n * item + n * n * item
+        if max(gram_res, scale_res) <= limit:
+            return "split"
+        return None
+
+
+def fused_ok(grid, m: int, n: int, mode: str, bm: int = 1024, g: int = 2,
+             *, dtype) -> bool:
+    """True when ANY fused pipeline tier can run (see fused_plan)."""
+    return fused_plan(grid, m, n, mode, bm, g, dtype=dtype) is not None

@@ -142,6 +142,66 @@ class TestFusedPipeline:
         assert float(residual.qr_orthogonality(Qf)) < 1e-14
         assert float(residual.qr_residual(A, Qf, Rf)) < 1e-13
 
+    def test_split_plan_matches_full(self, grid1):
+        # the wide-n streaming tier ('split': scale and sweep-2 gram as two
+        # kernels) must agree with the 'full' scale_gram tier exactly — the
+        # gram is taken from the SAME rounded Q1 either way
+        from capital_tpu.models.qr import _cqr2_fused
+
+        A = _tall(2048, 512).astype(jnp.float64)
+        cfg = CacqrConfig(num_iter=2, regime="1d", mode="pallas")
+        g = qr_fused.pick_g(512)
+        Qf, Rf = jax.jit(lambda a: _cqr2_fused(grid1, a, cfg, g, "full"))(A)
+        Qs, Rs = jax.jit(lambda a: _cqr2_fused(grid1, a, cfg, g, "split"))(A)
+        np.testing.assert_allclose(np.asarray(Qs), np.asarray(Qf), atol=1e-12)
+        np.testing.assert_allclose(np.asarray(Rs), np.asarray(Rf), atol=1e-10)
+        assert float(residual.qr_orthogonality(Qs)) < 1e-14
+
+    def test_fused_plan_tiers(self, grid1, monkeypatch):
+        # envelope arithmetic on a simulated v5e budget: narrow n -> 'full';
+        # n=4096 exceeds scale_gram's envelope but not the per-kernel ones
+        # -> 'split'; n=8192's gram alone exceeds VMEM -> None
+        from capital_tpu.ops import pallas_tpu
+
+        monkeypatch.setattr(pallas_tpu, "_default_backend", lambda: "tpu")
+        monkeypatch.setattr(
+            qr_fused, "_interpret_default", lambda: False
+        )
+        monkeypatch.setattr(
+            qr_fused, "_device_budget", lambda: (512, 128 << 20)
+        )
+        bf = jnp.bfloat16
+        assert qr_fused.fused_plan(
+            grid1, 1 << 21, 1024, "pallas", g=8, dtype=bf
+        ) == "full"
+        assert qr_fused.fused_plan(
+            grid1, 262144, 4096, "pallas", g=32, dtype=bf
+        ) == "split"
+        assert qr_fused.fused_plan(
+            grid1, 65536, 8192, "pallas", g=64, dtype=bf
+        ) is None
+
+    def test_wide_n_cholinv_route_matches_unfused(self, grid1):
+        # n >= 2048 routes the gram factor through the recursive cholinv
+        # on the UNASSEMBLED gram (zeros below the valid upper triangle) —
+        # the branch's correctness rests on cholinv never reading the
+        # lower half; this is the CI tripwire for that contract
+        m, n = 2304, 2048
+        A = _tall(m, n).astype(jnp.float64)
+        cfg = CacqrConfig(num_iter=2, regime="1d", mode="pallas")
+        g = qr_fused.pick_g(n)
+        assert qr_fused.fused_ok(grid1, m, n, "pallas", g=g, dtype=A.dtype)
+        Qf, Rf = jax.jit(lambda a: qr.factor(grid1, a, cfg))(A)
+        Qu, Ru = jax.jit(
+            lambda a: qr.factor(grid1, a, CacqrConfig(num_iter=2, regime="1d"))
+        )(A)
+        assert float(residual.qr_orthogonality(Qf)) < 1e-14
+        assert float(residual.qr_residual(A, Qf, Rf)) < 1e-13
+        np.testing.assert_allclose(np.asarray(Qf), np.asarray(Qu), atol=1e-9)
+        np.testing.assert_allclose(
+            np.triu(np.asarray(Rf)), np.triu(np.asarray(Ru)), atol=1e-7
+        )
+
     def test_fused_bf16_gates(self, grid1):
         A = _tall(1024, 512).astype(jnp.bfloat16)
         cfg = CacqrConfig(num_iter=2, regime="1d", mode="pallas")

@@ -73,8 +73,10 @@ class RectriConfig:
 def _batched_prefix_size(grid: Grid, p: int, cfg: RectriConfig) -> int:
     """Largest level size t = bc·2^j the global batched sweep should
     produce (t = bc means base cases only — the default), or 0 when
-    ineligible (disabled, a mesh — the stacks carry no face layout — or a
-    plan that is not a power-of-two chain of base cases)."""
+    ineligible (disabled, a mesh — the stacks carry no face layout — or
+    bc does not divide p).  Any bc-divisible chain gets at least the
+    base-only prefix; levels ABOVE bc additionally require a power-of-two
+    block count (they pair equal siblings)."""
     bc = cfg.base_case_dim
     nb = p // bc
     # any enabled setting keeps at least the base-only prefix: a positive
@@ -86,9 +88,16 @@ def _batched_prefix_size(grid: Grid, p: int, cfg: RectriConfig) -> int:
         and cfg.batch_below != 0
         and p % bc == 0
         and p >= bc
-        and nb & (nb - 1) == 0
     ):
         return 0
+    # the base-only prefix (t = bc) needs nothing beyond bc | p: the
+    # bc-aligned split rule makes every recursion leaf exactly a diagonal
+    # bc-block for ANY block count (round 5 — nb=96 at the 49152 bench row
+    # previously serialized all 96 leaf trtris, 12.7 ms of the 8% gap to
+    # target).  Batched merge LEVELS above bc still pair equal siblings,
+    # which only a power-of-two chain provides.
+    if nb & (nb - 1):
+        return bc
     t = bc
     while t * 2 <= min(limit, p):
         t *= 2
@@ -135,12 +144,10 @@ def _rectri_batched_prefix(
                 axis=1,
             )
         s *= 2
-    for i in range(p // t):
-        out = lax.dynamic_update_slice(
-            out,
-            lax.index_in_dim(W, i, keepdims=False).astype(out.dtype),
-            (i * t, i * t),
-        )
+    with tracing.scope("RT::batch_write"):
+        # in-place aliased block scatter: the dus-chain spelling costs a
+        # full `out` copy (~6 ms at the 49152 bench row)
+        out = pallas_tpu.write_diag_blocks(out, W)
     return out
 
 
@@ -174,7 +181,14 @@ def _rectri_into(
                 lax.dynamic_update_slice(out, inv.astype(out.dtype), (off, off))
             )
 
-    n1 = size // 2
+    if size % cfg.base_case_dim == 0:
+        # split on a base-case boundary: every leaf of the tree is then
+        # exactly a bc-aligned diagonal block (the batched prefix inverts
+        # all of them in one trtri_stack call, any block count), and every
+        # merge view stays 128-aligned for the in-place kernel path
+        n1 = (size // cfg.base_case_dim // 2) * cfg.base_case_dim
+    else:
+        n1 = size // 2
     n2 = size - n1
     out = _rectri_into(grid, Tp, out, off, n1, cfg, stop_at)
     out = _rectri_into(grid, Tp, out, off + n1, n2, cfg, stop_at)
@@ -250,10 +264,18 @@ def rectri(
         p = min(p, -(-n // 256) * 256)
     # embed diag(T, I): stays lower-triangular, inverts to diag(T⁻¹, I)
     Tp = grid.pin(pad_embed_identity(T, n, p))
-    out = grid.pin(jnp.zeros((p, p), dtype=T.dtype))
     t = _batched_prefix_size(grid, p, cfg)
     if t:
+        # the prefix's leaf scatter writes every diagonal t-block in full
+        # and the merge panels cover the whole strict-lower triangle, so
+        # only the strict-UPPER tiles need the zero fill (~half the init
+        # HBM traffic of a dense jnp.zeros; ~3 ms at the 49152 bench row)
+        out = grid.pin(
+            pallas_tpu.zeros_dead_lower(p, T.dtype, t, dead="upper")
+        )
         out = _rectri_batched_prefix(grid, Tp, out, p, t, cfg)
+    else:
+        out = grid.pin(jnp.zeros((p, p), dtype=T.dtype))
     out = _rectri_into(grid, Tp, out, 0, p, cfg, stop_at=t)
     out = grid.pin(out)
     return out[:n, :n] if p != n else out

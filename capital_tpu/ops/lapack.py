@@ -56,6 +56,43 @@ def trtri(T: jnp.ndarray, uplo: str = "U", unit_diag: bool = False) -> jnp.ndarr
     return out.astype(T.dtype)
 
 
+def trtri_newton(
+    D: jnp.ndarray,
+    unit_diag: bool = False,
+    precision: str | None = "highest",
+) -> jnp.ndarray:
+    """EXACT inverse of a (..., s, s) LOWER-triangular stack by the
+    finite-termination Newton iteration — all batched MXU matmuls, no
+    XLA:TPU triangular_solve custom call (which serializes its batch: a
+    384-stack of 512-blocks runs as 384 sequential solves, ~3.9 ms at the
+    rectri 49152 row vs ~0.2 ms for this spelling).
+
+    With X₀ = diag(D)⁻¹, the residual I − D·X₀ is STRICTLY lower
+    triangular, hence nilpotent of index s; the Newton step
+    X ← X·(2I − D·X) squares the residual, so ⌈log₂ s⌉ steps terminate
+    with the exact inverse (in exact arithmetic — in floats, to the same
+    roundoff class as substitution).  Products of lower triangles are
+    lower triangles even in floating point, so the structural zeros hold
+    without masking.  Runs at the >= f32 compute dtype, casts back once."""
+    ct = _compute_dtype(D.dtype)
+    s = D.shape[-1]
+    if unit_diag:
+        # never read the stored diagonal (by unit-diag convention it is
+        # meaningless and may be inf/nan)
+        Dm = jnp.tril(D, -1).astype(ct) + jnp.eye(s, dtype=ct)
+        d = jnp.ones(D.shape[:-1], dtype=ct)
+    else:
+        Dm = jnp.tril(D).astype(ct)
+        d = jnp.diagonal(Dm, axis1=-2, axis2=-1)
+    X = (1.0 / d)[..., :, None] * jnp.eye(s, dtype=ct)
+    two_eye = 2.0 * jnp.eye(s, dtype=ct)
+    steps = max(1, (s - 1).bit_length())
+    for _ in range(steps):
+        DX = jnp.matmul(Dm, X, precision=precision)
+        X = jnp.matmul(X, two_eye - DX, precision=precision)
+    return X.astype(D.dtype)
+
+
 def diag_block_stack(X: jnp.ndarray, o: int, s: int, stride: int) -> jnp.ndarray:
     """(count, s, s) stack of the diagonal-band blocks
     ``X[..., i*stride + o : i*stride + o + s, i*stride : i*stride + s]``,
@@ -136,7 +173,13 @@ def trtri_stack(
         precision = "highest"
     Dm = jnp.tril(D).astype(ct)
 
-    W = trtri(diag_block_stack(Dm, 0, inner, inner), uplo="L", unit_diag=unit_diag)
+    # inner blocks via the exact-termination Newton iteration: the batched
+    # triangular_solve custom call serializes even the inner batch (round 5
+    # — it was the remaining serial term of the rectri/trsm base phase)
+    W = trtri_newton(
+        diag_block_stack(Dm, 0, inner, inner), unit_diag=unit_diag,
+        precision=precision,
+    )
     s = inner
     while s < bc:
         A21 = diag_block_stack(Dm, s, s, 2 * s)

@@ -355,22 +355,29 @@ def zeros_dead_lower(
     tile: int,
     extra: tuple[tuple[int, int, int, int], ...] = (),
     interpret: bool | None = None,
+    dead: str = "lower",
 ) -> jnp.ndarray:
-    """A p x p buffer whose strictly-sub-diagonal `tile`-blocks (plus any
-    `extra` (r0, c0, rows, cols) windows) are zero-filled; every OTHER tile
-    is left unwritten, i.e. undefined garbage on hardware.
+    """A p x p buffer whose strictly-sub-diagonal `tile`-blocks — or the
+    strictly-SUPER-diagonal ones with dead='upper' (the rectri output's
+    orientation) — plus any `extra` (r0, c0, rows, cols) windows are
+    zero-filled; every OTHER tile is left unwritten, i.e. undefined garbage
+    on hardware.
 
-    For callers that overwrite the whole upper triangle anyway (cholinv's
+    For callers that overwrite the whole live triangle anyway (cholinv's
     factor buffers: leaf windows + TRSM/inverse-completion panels cover it
-    exactly), this halves the buffer-initialization HBM traffic vs
-    jnp.zeros — ~0.8ms/iter at n=16k bf16 on v5e, 2x that at 32k.  Falls
-    back to a plain jnp.zeros when the tiling cannot be expressed."""
+    exactly; rectri's leaf-block scatter + merge panels likewise), this
+    halves the buffer-initialization HBM traffic vs jnp.zeros — ~0.8ms/iter
+    at n=16k bf16 on v5e, 2x that at 32k.  Falls back to a plain jnp.zeros
+    when the tiling cannot be expressed."""
     if interpret is None:
         interpret = _interpret_default()
     if tile % 128 or p % tile or tile < 128:
         return jnp.zeros((p, p), dtype)
     nt = p // tile
-    tiles = [(i, j) for i in range(nt) for j in range(nt) if i > j]
+    if dead == "lower":
+        tiles = [(i, j) for i in range(nt) for j in range(nt) if i > j]
+    else:
+        tiles = [(i, j) for i in range(nt) for j in range(nt) if i < j]
     for (r0, c0, rr, cc) in extra:
         if r0 % tile or c0 % tile or rr % tile or cc % tile:
             return jnp.zeros((p, p), dtype)
@@ -403,6 +410,50 @@ def zeros_dead_lower(
         out_shape=jax.ShapeDtypeStruct((p, p), dtype),
         interpret=interpret,
     )(io, jo)
+
+
+def write_diag_blocks(
+    out: jnp.ndarray,
+    W: jnp.ndarray,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Write stack W[i] (count, s, s) onto the diagonal blocks
+    ``out[i*s:(i+1)*s, i*s:(i+1)*s]`` in place (input_output_aliases:
+    every other region of `out` is preserved, no full-buffer copy).  The
+    dynamic_update_slice chain spelling of the same write costs a whole
+    `out` copy (~6 ms on a 49152² bf16 buffer — the rectri batched-prefix
+    write-back, round 5); this kernel touches only the visited blocks.
+    The caller must treat the passed `out` as consumed.  Falls back to the
+    dus chain when the block size cannot tile (s % 128 or shape mismatch).
+    """
+    if interpret is None:
+        interpret = _interpret_default()
+    count, s, s2 = W.shape
+    if s != s2 or s % 128 or out.shape[0] < count * s or out.shape[0] != out.shape[1]:
+        res = out
+        for i in range(count):
+            res = lax.dynamic_update_slice(
+                res, lax.index_in_dim(W, i, keepdims=False).astype(out.dtype),
+                (i * s, i * s),
+            )
+        return res
+
+    def kernel(w_ref, oin_ref, out_ref):
+        del oin_ref  # aliased storage; never read
+        out_ref[:] = w_ref[0]
+
+    return pl.pallas_call(
+        kernel,
+        grid=(count,),
+        in_specs=[
+            pl.BlockSpec((1, s, s), lambda q: (q, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((s, s), lambda q: (q, q), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(out.shape, out.dtype),
+        input_output_aliases={1: 0},
+        interpret=interpret,
+    )(W.astype(out.dtype), out)
 
 
 def transpose(

@@ -88,12 +88,15 @@ def auto_base_case(n: int) -> int:
     candidate minimizing the padded dim (least wasted flops), not blindly
     512 — and warn; main() also records the padded dim in the JSON line so
     non-interactive consumers see the cost."""
+    from capital_tpu.bench.drivers import pick_bc
     from capital_tpu.models import cholesky
 
-    for cand in (512, 384, 256):
-        if cholesky.padded_dim(n, cand) == n:
-            return cand
-    best = min((512, 384, 256), key=lambda c: (cholesky.padded_dim(n, c), -c))
+    # ONE picker shared with the drivers (padding-aware; below the
+    # small-N crossovers finer leaves shorten the latency-bound potrf
+    # chain — docs/PERF.md "Small-N — round 5")
+    best = pick_bc(n)
+    if cholesky.padded_dim(n, best) == n:
+        return best
     print(
         f"# warning: no 128-multiple base tiles n={n} exactly; "
         f"padding to {cholesky.padded_dim(n, best)} with bc={best} "

@@ -193,14 +193,42 @@ def _grid(args) -> Grid:
 # --------------------------------------------------------------------------
 
 
+def pick_bc(n: int, override: int = 0, cholinv_family: bool = True) -> int:
+    """Padding-aware base-case auto-pick (--bc 0), shared with bench.py's
+    auto_base_case.  The cholinv family's leaf potrf chain is the latency
+    floor at small n, so finer leaves win below the measured crossovers
+    (docs/PERF.md "Small-N — round 5": at n=4096, 128/256/512 measure
+    25.3/24.7/23.5 TF/s; at n=8192, 57.5/60.3/59.1; 512 holds from 16384
+    up within drift).  Candidates that tile n exactly are preferred; when
+    none does, the same preference order breaks ties among the least-
+    padding candidates.  Non-cholinv drivers keep the committed 512."""
+    if override:
+        return override
+    from capital_tpu.models import cholesky as _ch
+
+    if not cholinv_family:
+        return 512
+    if n <= 4096:
+        order = (128, 256, 512, 384)
+    elif n <= 8192:
+        order = (256, 512, 384, 128)
+    else:
+        order = (512, 384, 256)
+    for cand in order:
+        if _ch.padded_dim(n, cand) == n:
+            return cand
+    return min(order, key=lambda c: (_ch.padded_dim(n, c), order.index(c)))
+
+
 def cholinv(args) -> dict:
     grid = _grid(args)
     mode = _resolve_mode(args.mode, grid)
     dtype = jnp.dtype(args.dtype)
+    bc = pick_bc(args.n, args.bc)
     cfg = cholesky.CholinvConfig(
         complete_inv=not args.no_complete_inv,
         split=args.split,
-        base_case_dim=args.bc,
+        base_case_dim=bc,
         mode=mode,
         precision=_precision(args, dtype),
     )
@@ -213,7 +241,7 @@ def cholinv(args) -> dict:
     t, extra = _timed(args, step, A)
     flops = 2.0 * args.n**3 / 3.0  # factor n³/3 + triangular inverse n³/3
     rec = harness.report(
-        "cholinv_tflops", t, flops, dtype, n=args.n, grid=repr(grid), bc=args.bc,
+        "cholinv_tflops", t, flops, dtype, n=args.n, grid=repr(grid), bc=bc,
         mode=mode, **_knobs(args), **extra,
     )
     if args.validate:
@@ -230,6 +258,7 @@ def cholinv(args) -> dict:
 
 
 def cacqr(args) -> dict:
+    bc = pick_bc(args.n, args.bc, cholinv_family=False)
     # tall-skinny topology: the reference uses a tunable rect grid
     # (topology.h:16-65); the 1d/auto regimes want the whole mesh on the
     # long axis (Grid.flat), 'dist' wants a square face
@@ -250,7 +279,7 @@ def cacqr(args) -> dict:
         regime=args.regime,
         mode=mode,
         cholinv=cholesky.CholinvConfig(
-            base_case_dim=args.bc, mode=mode, precision=precision
+            base_case_dim=bc, mode=mode, precision=precision
         ),
         precision=precision,
         fused_g=getattr(args, "fused_g", 0),
@@ -378,13 +407,14 @@ def _tri_operand(n: int, dtype, seed: int = 0) -> jnp.ndarray:
 
 
 def rectri(args) -> dict:
+    bc = pick_bc(args.n, args.bc, cholinv_family=False)
     grid = _grid(args)
     mode = _resolve_mode(args.mode, grid)
     dtype = jnp.dtype(args.dtype)
     L = _tri_operand(args.n, dtype)
     extra_cfg = {} if args.batch_below < 0 else {"batch_below": args.batch_below}
     cfg = inverse.RectriConfig(
-        base_case_dim=args.bc, mode=mode,
+        base_case_dim=bc, mode=mode,
         precision=_precision(args, dtype), **extra_cfg,
     )
 
@@ -448,11 +478,12 @@ def newton(args) -> dict:
 
 
 def spd_inverse(args) -> dict:
+    bc = pick_bc(args.n, args.bc)
     grid = _grid(args)
     mode = _resolve_mode(args.mode, grid)
     dtype = jnp.dtype(args.dtype)
     cfg = cholesky.CholinvConfig(
-        base_case_dim=args.bc, mode=mode,
+        base_case_dim=bc, mode=mode,
         precision=_precision(args, dtype),
     )
     A = _spd(args.n, dtype)
@@ -484,6 +515,7 @@ def trsm(args) -> dict:
     surface at the bench size."""
     from capital_tpu.models import trsm as trsm_mod
 
+    bc = pick_bc(args.n, args.bc, cholinv_family=False)
     grid = _grid(args)
     # 'auto' resolves to xla for the invert leaf, not the usual single-TPU
     # pallas pick: with diaginvert leaves every TRSM gemm is DENSE
@@ -501,7 +533,7 @@ def trsm(args) -> dict:
         jax.random.normal(jax.random.key(1), (args.n, nrhs), dtype=dtype)
     )
     cfg = trsm_mod.TrsmConfig(
-        base_case_dim=args.bc, mode=mode, precision=_precision(args, dtype),
+        base_case_dim=bc, mode=mode, precision=_precision(args, dtype),
         leaf=args.leaf,
     )
 
@@ -526,7 +558,7 @@ def trsm(args) -> dict:
     flops = 1.0 * args.n**2 * nrhs
     rec = harness.report(
         "trsm_tflops", t, flops, dtype, n=args.n, nrhs=nrhs, grid=repr(grid),
-        bc=args.bc, mode=mode, **_knobs(args), **extra,
+        bc=bc, mode=mode, **_knobs(args), **extra,
     )
     if args.validate:
         # each combo solves + checks inside ONE jit over (L, B) arguments
@@ -597,7 +629,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--k", type=int, default=4096)
     p.add_argument("--dtype", default="bfloat16")
     p.add_argument("--iters", type=int, default=3)
-    p.add_argument("--bc", type=int, default=512, help="base-case dim")
+    p.add_argument(
+        "--bc", type=int, default=0,
+        help="base-case dim (0 = auto: cholinv/spd pick 256 below the "
+        "n<=8192 crossover, 512 above; every other driver keeps 512)",
+    )
     p.add_argument("--split", type=int, default=1)
     p.add_argument(
         "--mode", default="auto", choices=["auto", "xla", "explicit", "pallas"],

@@ -220,7 +220,7 @@ def print_budget(budget: dict[str, float], iters: int, label: str) -> dict:
     return rec
 
 
-def _cholinv_run(n: int, dtype, bc: int, iters: int, oneshot: bool):
+def _cholinv_run(n: int, dtype, bc: int, iters: int, oneshot: bool, prec=None):
     """The flagship loop (bench.py's shape: fori_loop + element coupling),
     compiled once and traced for `iters` iterations."""
     from capital_tpu.models import cholesky
@@ -229,7 +229,7 @@ def _cholinv_run(n: int, dtype, bc: int, iters: int, oneshot: bool):
     grid = Grid.square(c=1, devices=[jax.devices()[0]])
     cfg = cholesky.CholinvConfig(
         base_case_dim=bc, mode="pallas",
-        precision=None if jnp.dtype(dtype).itemsize < 4 else "highest",
+        precision=prec,
         schur_in_place=oneshot,
     )
     eps = jnp.asarray(0.0, jnp.float32)
@@ -290,7 +290,7 @@ def _cholinv_run(n: int, dtype, bc: int, iters: int, oneshot: bool):
     return run
 
 
-def _rectri_run(n: int, dtype, bc: int, iters: int):
+def _rectri_run(n: int, dtype, bc: int, iters: int, prec=None):
     from capital_tpu.bench.drivers import _tri_operand
     from capital_tpu.models import inverse
     from capital_tpu.parallel.topology import Grid
@@ -298,7 +298,7 @@ def _rectri_run(n: int, dtype, bc: int, iters: int):
     grid = Grid.square(c=1, devices=[jax.devices()[0]])
     cfg = inverse.RectriConfig(
         base_case_dim=bc, mode="pallas",
-        precision=None if jnp.dtype(dtype).itemsize < 4 else "highest",
+        precision=prec,
     )
     T = _tri_operand(n, dtype)
     eps = jnp.asarray(0.0, jnp.float32)
@@ -318,12 +318,12 @@ def _rectri_run(n: int, dtype, bc: int, iters: int):
     return run
 
 
-def _cacqr_run(m: int, n: int, dtype, bc: int, iters: int):
+def _cacqr_run(m: int, n: int, dtype, bc: int, iters: int, prec=None):
     from capital_tpu.models import cholesky, qr
     from capital_tpu.parallel.topology import Grid
 
     grid = Grid.square(c=1, devices=[jax.devices()[0]])
-    precision = None if jnp.dtype(dtype).itemsize < 4 else "highest"
+    precision = prec
     cfg = qr.CacqrConfig(
         num_iter=2, mode="pallas",
         cholinv=cholesky.CholinvConfig(
@@ -351,7 +351,7 @@ def _cacqr_run(m: int, n: int, dtype, bc: int, iters: int):
     return run
 
 
-def _trsm_run(n: int, nrhs: int, dtype, bc: int, iters: int):
+def _trsm_run(n: int, nrhs: int, dtype, bc: int, iters: int, prec=None):
     from capital_tpu.bench.drivers import _tri_operand
     from capital_tpu.models import trsm as trsm_mod
     from capital_tpu.parallel.topology import Grid
@@ -359,7 +359,7 @@ def _trsm_run(n: int, nrhs: int, dtype, bc: int, iters: int):
     grid = Grid.square(c=1, devices=[jax.devices()[0]])
     cfg = trsm_mod.TrsmConfig(
         base_case_dim=bc, mode="xla",
-        precision=None if jnp.dtype(dtype).itemsize < 4 else "highest",
+        precision=prec,
     )
     L = _tri_operand(n, dtype)
     B = jax.block_until_ready(
@@ -397,24 +397,35 @@ def main(argv=None) -> None:
                         "flagship protocol) instead of the carry loop")
     p.add_argument("--trace-dir", default=None,
                    help="keep the raw trace here instead of a temp dir")
+    p.add_argument("--precision", default=None,
+                   choices=["default", "high", "highest"],
+                   help="override the matmul precision ('high' traces the "
+                        "f32 3-pass family, 'default' the TPU-default "
+                        "1-pass) — same semantics as the drivers CLI")
     args = p.parse_args(argv)
     dtype = jnp.dtype(args.dtype)
+    # ONE precision rule shared with the drivers CLI (drivers._precision):
+    # 'default' -> None (TPU default), unset -> the dtype rule
+    from capital_tpu.bench.drivers import _precision
+
+    prec = _precision(args, dtype)
+    ptag = f" prec={args.precision}" if args.precision else ""
 
     if args.algo == "cholinv":
-        run = _cholinv_run(args.n, dtype, args.bc, args.iters, args.oneshot)
+        run = _cholinv_run(args.n, dtype, args.bc, args.iters, args.oneshot, prec)
         label = f"cholinv n={args.n} bc={args.bc} {dtype}" + (
             " oneshot" if args.oneshot else ""
-        )
+        ) + ptag
     elif args.algo == "rectri":
-        run = _rectri_run(args.n, dtype, args.bc, args.iters)
-        label = f"rectri n={args.n} bc={args.bc} {dtype}"
+        run = _rectri_run(args.n, dtype, args.bc, args.iters, prec)
+        label = f"rectri n={args.n} bc={args.bc} {dtype}" + ptag
     elif args.algo == "trsm":
         nrhs = min(args.m, args.n)
-        run = _trsm_run(args.n, nrhs, dtype, args.bc, args.iters)
-        label = f"trsm n={args.n} nrhs={nrhs} bc={args.bc} {dtype}"
+        run = _trsm_run(args.n, nrhs, dtype, args.bc, args.iters, prec)
+        label = f"trsm n={args.n} nrhs={nrhs} bc={args.bc} {dtype}" + ptag
     else:
-        run = _cacqr_run(args.m, args.n, dtype, args.bc, args.iters)
-        label = f"cacqr {args.m}x{args.n} {dtype}"
+        run = _cacqr_run(args.m, args.n, dtype, args.bc, args.iters, prec)
+        label = f"cacqr {args.m}x{args.n} {dtype}" + ptag
 
     budget = device_budget(run, args.trace_dir)
     print_budget(budget, args.iters, label)

@@ -283,6 +283,38 @@ def tri_fractions(
     return sum(fracs) / len(fracs), max(fracs)
 
 
+def _shard_kernels_gate(
+    grid: Grid,
+    M: int,
+    K: int,
+    N: int,
+    a_uplo: str | None,
+    b_uplo: str | None,
+    out_uplo: str | None,
+    cyclic_rows: int = 0,
+    cyclic_out: int = 0,
+) -> bool:
+    """Does the explicit schedule route its local compute through the
+    live-tile Mosaic kernels per shard?  (round 5 — d == 1 grids with
+    128-aligned blocks and static liveness; see _explicit_matmul.)  ONE
+    predicate shared by the router and the cost model, so the executed
+    view (flops_vol/flops_max) prices the tile skipping exactly when it
+    happens."""
+    d, c = grid.dx, grid.c
+    q = max(1, grid.num_chunks)
+    structured = (
+        a_uplo is not None or b_uplo is not None or out_uplo is not None
+    )
+    if not (structured and d == 1 and grid.dy == 1 and c == 1 and q == 1):
+        return False
+    if cyclic_rows or cyclic_out:
+        return False
+    if M % d or K % d or N % d:
+        return False
+    mb, nb, lk = M // d, N // d, K // d
+    return mb % 128 == 0 and nb % 128 == 0 and lk % 128 == 0
+
+
 def _explicit_matmul(
     grid: Grid,
     A: jnp.ndarray,
@@ -412,6 +444,25 @@ def _explicit_matmul(
 
     solo = getattr(grid, "collective_concurrency", "free") == "solo"
 
+    # round 5 (VERDICT r4 #2, second half): route the LOCAL compute of the
+    # explicit schedule through the live-tile Mosaic kernels per shard —
+    # the reference's per-rank BLAS trmm/syrk saving at tile granularity
+    # (blas/interface.hpp:74-97) instead of K-segment granularity.  Inside
+    # shard_map the partitioning is manual, so the single-device kernels
+    # compile unchanged (the fused-CQR2 finding).  First increment: d == 1
+    # grids, where liveness is static — this is exactly the configuration
+    # that prices the mesh machinery's overhead (the DISTRIBUTED.md
+    # single-chip constant), and tile skipping removes its 2x flop
+    # penalty.  d > 1 needs runtime (device-indexed) schedules and stays
+    # on the K-segment path.  check_vma is disabled on this route: the
+    # kernels' out_shapes carry no varying-axes annotation, and the
+    # guarded-zeros vma logic is never reached.
+    shard_kernels = _shard_kernels_gate(
+        grid, M, K, N, a_uplo, b_uplo, out_uplo, cyclic_rows, cyclic_out
+    )
+    if shard_kernels:
+        tracing.note("explicit::shard_kernels")
+
     def kernel(a, b):
         # a: (M/d, K/d) block at (x, y);  b: (K/d, N/d) block at (x, y)
         xi = lax.axis_index("x")
@@ -439,6 +490,20 @@ def _explicit_matmul(
                 # operand small and the dependency real)
                 token[0] = lax.slice(res.reshape(-1), (0,), (1,))
             return res
+
+        if shard_kernels:
+            a_ch = stamp(lax.all_gather(chain(a), "y", axis=1, tiled=True))
+            b_ch = stamp(lax.all_gather(chain(b), "x", axis=0, tiled=True))
+            if out_uplo is not None:
+                part = pallas_tpu.tri_matmul(
+                    a_ch, b_ch, out_uplo=out_uplo, precision=precision
+                )
+            else:
+                part = pallas_tpu.tri_matmul(
+                    a_ch, b_ch, a_uplo=a_uplo, b_uplo=b_uplo,
+                    precision=precision,
+                )
+            return part.astype(wire_dtype)
 
         # every liveness test guards ONLY local matmuls, never a collective:
         # the gathers run unconditionally on all devices (a collective under
@@ -624,6 +689,7 @@ def _explicit_matmul(
         mesh=grid.mesh,
         in_specs=(P("x", "y"), P("x", "y")),
         out_specs=P("x", "y"),
+        check_vma=not shard_kernels,
     )(grid.pin(A), grid.pin(B))
 
 
@@ -657,10 +723,17 @@ def _matmul(
         grid, M, N, K, jnp.result_type(A, B)
     )
     if mode == "explicit":
-        mean_f, max_f = tri_fractions(
-            grid, M, K, N, a_uplo, b_uplo, out_uplo,
-            cyclic_rows=cyclic_rows, cyclic_out=cyclic_out,
-        )
+        if _shard_kernels_gate(
+            grid, M, K, N, a_uplo, b_uplo, out_uplo, cyclic_rows, cyclic_out
+        ):
+            # per-shard live-tile kernels: same /2 executed convention as
+            # the single-device pallas branches (tile skipping)
+            mean_f = max_f = 0.5
+        else:
+            mean_f, max_f = tri_fractions(
+                grid, M, K, N, a_uplo, b_uplo, out_uplo,
+                cyclic_rows=cyclic_rows, cyclic_out=cyclic_out,
+            )
     else:
         mean_f = max_f = 1.0  # dense+mask executes the full contraction
     tracing.emit(

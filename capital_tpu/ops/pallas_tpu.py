@@ -412,6 +412,112 @@ def zeros_dead_lower(
     )(io, jo)
 
 
+def sched_matmul(
+    A: jnp.ndarray,
+    B: jnp.ndarray,
+    to: jnp.ndarray,
+    ko: jnp.ndarray,
+    first: jnp.ndarray,
+    last: jnp.ndarray,
+    *,
+    tri_side: str = "a",
+    blocks: tuple[int, int, int],
+    precision: str | None = None,
+    interpret: bool | None = None,
+    vmem_limit: int | None = None,
+) -> jnp.ndarray:
+    """C = A @ B visiting ONLY the (tile, k-tile) pairs listed in the
+    RUNTIME scalar-prefetch arrays — the device-indexed schedule that
+    makes per-shard tile skipping work on d > 1 meshes (round 5): each
+    device of a shard_map body selects its own row of a stacked schedule
+    (jnp.take by lax.axis_index) and hands it here; the grid length is
+    the padded maximum, so SPMD lockstep costs nothing extra (wall time
+    is the fullest device either way).
+
+    tri_side='a': pairs are (row-tile of A/C, k-tile) — the side-L trmm
+    shape; 'b': (col-tile of B/C, k-tile) — side-R.  `first`/`last` mark
+    each tile's first/last live k-step (accumulator zero/flush).  Pad
+    entries must REPEAT the final real pair with first=0, last=0: they
+    re-accumulate into the scratch accumulator after its last flush and
+    are never written back.  Operands must be pre-masked (dead triangles
+    zero) — the kernel applies no intra-tile masks, so boundary tiles
+    multiply zeros, exactly like the K-segment schedule it replaces."""
+    if interpret is None:
+        interpret = _interpret_default()
+    if vmem_limit is None and not interpret:
+        vmem_limit = _device_budget()[1]
+    (M, K), (_, N) = A.shape, B.shape
+    bm, bn, bk = blocks
+    nm, nn, nk = M // bm, N // bn, K // bk
+    acc_dtype = jnp.promote_types(jnp.result_type(A, B), jnp.float32)
+    if jnp.dtype(acc_dtype).itemsize > 4 and _platform() == "tpu":
+        acc_dtype = jnp.float32
+    accumulate = _make_accumulate(
+        a_uplo=None, a_trans=False, b_uplo=None, b_trans=False,
+        bm=bm, bn=bn, bk=bk, acc_dtype=acc_dtype, precision=precision,
+        operand_dtypes=(A.dtype, B.dtype),
+    )
+    a_is_tri = tri_side == "a"
+    out_dtype = jnp.result_type(A, B)
+
+    def kernel(to_ref, ko_ref, fi_ref, la_ref, a_ref, b_ref, out_ref, acc_ref):
+        q, p = pl.program_id(0), pl.program_id(1)
+        t, k = to_ref[p], ko_ref[p]
+        i, j = (t, q) if a_is_tri else (q, t)
+
+        @pl.when(fi_ref[p] == 1)
+        def _():
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+
+        accumulate(a_ref, b_ref, acc_ref, i, j, k)
+
+        @pl.when(la_ref[p] == 1)
+        def _():
+            _flush(acc_ref, out_ref, 1.0, None, 0, 0)
+
+    if a_is_tri:
+        a_map = lambda q, p, to, ko, fi, la: (to[p], ko[p])
+        b_map = lambda q, p, to, ko, fi, la: (ko[p], q)
+        out_map = lambda q, p, to, ko, fi, la: (to[p], q)
+        n_outer = nn
+    else:
+        a_map = lambda q, p, to, ko, fi, la: (q, ko[p])
+        b_map = lambda q, p, to, ko, fi, la: (ko[p], to[p])
+        out_map = lambda q, p, to, ko, fi, la: (q, to[p])
+        n_outer = nm
+
+    # callers run this under shard_map with replication checking disabled
+    # (the interpret-mode carry-vma limitation), so the out_shape carries
+    # no varying-axes annotation
+    out_struct = jax.ShapeDtypeStruct((M, N), out_dtype)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(n_outer, to.shape[0]),
+        in_specs=[
+            pl.BlockSpec((bm, bk), a_map, memory_space=pltpu.VMEM),
+            pl.BlockSpec((bk, bn), b_map, memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), out_map, memory_space=pltpu.VMEM),
+        scratch_shapes=[pltpu.VMEM((bm, bn), acc_dtype)],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=out_struct,
+        cost_estimate=pl.CostEstimate(
+            flops=2 * M * N * K,
+            bytes_accessed=(M * K + K * N + M * N)
+            * jnp.dtype(out_dtype).itemsize,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary"),
+            vmem_limit_bytes=vmem_limit,
+        ),
+    )(to, ko, first, last, A, B)
+
+
 def write_diag_blocks(
     out: jnp.ndarray,
     W: jnp.ndarray,

@@ -315,6 +315,107 @@ def _shard_kernels_gate(
     return mb % 128 == 0 and nb % 128 == 0 and lk % 128 == 0
 
 
+def _sched_blocks(mb: int, K: int, nb: int) -> tuple[int, int, int]:
+    """(bm, bk, bn) tile sizes for the runtime-scheduled route: the largest
+    of 512/256/128 dividing the extent AND leaving >= 4 tiles (skipping
+    granularity — a single whole-extent tile can never be skipped), else
+    the SMALLEST divisor (maximum granularity), else 0 (cannot tile)."""
+
+    def pick(x: int) -> int:
+        for b in (512, 256, 128):
+            if x % b == 0 and x // b >= 4:
+                return b
+        for b in (128, 256, 512):
+            if x % b == 0:
+                return b
+        return 0
+
+    return pick(mb), pick(K), pick(nb)
+
+
+def _sched_pairs(grid, M, K, N, a_uplo, b_uplo):
+    """Per-device tile schedules for the d > 1 scheduled-kernel trmm route
+    (round 5): (TO, KO, FI, LA) int32 arrays of shape (d, L) — device i's
+    live (tile, k-tile) pairs, padded to the maximum by repeating the last
+    pair with first=last=0 (safe no-ops, pallas_tpu.sched_matmul) — plus
+    the executed fraction L/(nt*nk) and the block sizes.  None when the
+    shapes cannot tile.  Every device runs L steps (SPMD lockstep makes
+    the fullest device the wall time regardless), so the padded schedule
+    costs nothing over the ideal."""
+    import numpy as _np
+
+    d = grid.dx
+    mb, nb = M // d, N // d
+    bm, bk, bn = _sched_blocks(mb, K, nb)
+    if not (bm and bk and bn):
+        return None
+    uplo = a_uplo if a_uplo is not None else b_uplo
+    a_side = a_uplo is not None
+    bt = bm if a_side else bn
+    nt, nk = (mb if a_side else nb) // bt, K // bk
+    per_dev = []
+    for xi in range(d):
+        pairs = []
+        for t in range(nt):
+            r0 = xi * (mb if a_side else nb) + t * bt
+            for k in range(nk):
+                c0 = k * bk
+                if a_side:
+                    # A (M, K) triangular: row-tile origin r0, K origin c0
+                    live = (c0 < r0 + bt) if uplo == "L" else (c0 + bk > r0)
+                else:
+                    # B (K, N) triangular: K origin c0 (rows), col origin r0
+                    live = (c0 + bk > r0) if uplo == "L" else (c0 < r0 + bt)
+                if live:
+                    pairs.append((t, k))
+        if not pairs:
+            return None
+        per_dev.append(pairs)
+    L = max(len(p) for p in per_dev)
+    TO = _np.zeros((d, L), _np.int32)
+    KO = _np.zeros((d, L), _np.int32)
+    FI = _np.zeros((d, L), _np.int32)
+    LA = _np.zeros((d, L), _np.int32)
+    for xi, pairs in enumerate(per_dev):
+        for idx, (t, k) in enumerate(pairs):
+            TO[xi, idx], KO[xi, idx] = t, k
+            FI[xi, idx] = 1 if idx == 0 or pairs[idx - 1][0] != t else 0
+            LA[xi, idx] = (
+                1 if idx == len(pairs) - 1 or pairs[idx + 1][0] != t else 0
+            )
+        TO[xi, len(pairs):], KO[xi, len(pairs):] = pairs[-1]
+    frac = L / float(nt * nk)
+    if frac >= 1.0:
+        # nothing skippable at this tiling (e.g. a single whole-extent
+        # tile): the kernel adds bookkeeping over the segment loop for no
+        # executed-flop win — stay on the segment path
+        return None
+    return (
+        (jnp.asarray(TO), jnp.asarray(KO), jnp.asarray(FI), jnp.asarray(LA)),
+        frac,
+        (bm, bn, bk),
+    )
+
+
+def _shard_sched_gate(grid, M, K, N, a_uplo, b_uplo, out_uplo,
+                      cyclic_rows=0, cyclic_out=0):
+    """Does the d > 1 explicit schedule route through the runtime-scheduled
+    per-shard kernels?  trmm shapes only (exactly one triangular operand);
+    c == 1, unchunked, tileable.  Shared by the router and the cost model
+    like _shard_kernels_gate."""
+    d, c = grid.dx, grid.c
+    q = max(1, grid.num_chunks)
+    if not (d > 1 and grid.dy == d and c == 1 and q == 1):
+        return None
+    if (a_uplo is None) == (b_uplo is None) or out_uplo is not None:
+        return None
+    if cyclic_rows or cyclic_out:
+        return None
+    if M % d or K % d or N % d:
+        return None
+    return _sched_pairs(grid, M, K, N, a_uplo, b_uplo)
+
+
 def _explicit_matmul(
     grid: Grid,
     A: jnp.ndarray,
@@ -325,8 +426,12 @@ def _explicit_matmul(
     out_uplo: str | None = None,
     cyclic_rows: int = 0,
     cyclic_out: int = 0,
+    sched=None,
 ) -> jnp.ndarray:
     """C = A @ B with the explicit SUMMA schedule on the d x d x c grid.
+    `sched` forwards _matmul's already-built device schedule (the cost
+    model evaluates the same gate; building the O(d·nt·nk) arrays twice
+    per trace would be pure waste) — direct callers may omit it.
 
     Schedule (the reference's distribute/compute/collect, summa.hpp:177-249,
     re-expressed with the collectives TPU SPMD actually has):
@@ -462,6 +567,13 @@ def _explicit_matmul(
     )
     if shard_kernels:
         tracing.note("explicit::shard_kernels")
+        sched = None
+    elif sched is None:  # direct callers: build what _matmul forwards
+        sched = _shard_sched_gate(
+            grid, M, K, N, a_uplo, b_uplo, out_uplo, cyclic_rows, cyclic_out
+        )
+    if sched is not None:
+        tracing.note("explicit::shard_sched")
 
     def kernel(a, b):
         # a: (M/d, K/d) block at (x, y);  b: (K/d, N/d) block at (x, y)
@@ -503,6 +615,21 @@ def _explicit_matmul(
                     a_ch, b_ch, a_uplo=a_uplo, b_uplo=b_uplo,
                     precision=precision,
                 )
+            return part.astype(wire_dtype)
+        if sched is not None:
+            # d > 1: each device selects ITS OWN tile schedule by mesh
+            # position and runs the scheduled kernel on the gathered slabs
+            (TO, KO, FI, LA), _, blocks = sched
+            a_ch = stamp(lax.all_gather(chain(a), "y", axis=1, tiled=True))
+            b_ch = stamp(lax.all_gather(chain(b), "x", axis=0, tiled=True))
+            sel = xi if a_uplo is not None else yi
+            part = pallas_tpu.sched_matmul(
+                a_ch, b_ch,
+                jnp.take(TO, sel, axis=0), jnp.take(KO, sel, axis=0),
+                jnp.take(FI, sel, axis=0), jnp.take(LA, sel, axis=0),
+                tri_side="a" if a_uplo is not None else "b",
+                blocks=blocks, precision=precision,
+            )
             return part.astype(wire_dtype)
 
         # every liveness test guards ONLY local matmuls, never a collective:
@@ -689,7 +816,7 @@ def _explicit_matmul(
         mesh=grid.mesh,
         in_specs=(P("x", "y"), P("x", "y")),
         out_specs=P("x", "y"),
-        check_vma=not shard_kernels,
+        check_vma=not (shard_kernels or sched is not None),
     )(grid.pin(A), grid.pin(B))
 
 
@@ -723,12 +850,22 @@ def _matmul(
         grid, M, N, K, jnp.result_type(A, B)
     )
     if mode == "explicit":
+        sched = None
         if _shard_kernels_gate(
             grid, M, K, N, a_uplo, b_uplo, out_uplo, cyclic_rows, cyclic_out
         ):
             # per-shard live-tile kernels: same /2 executed convention as
             # the single-device pallas branches (tile skipping)
             mean_f = max_f = 0.5
+        elif (
+            sched := _shard_sched_gate(
+                grid, M, K, N, a_uplo, b_uplo, out_uplo, cyclic_rows,
+                cyclic_out,
+            )
+        ) is not None:
+            # runtime-scheduled per-shard kernels: every device runs the
+            # padded maximum schedule, so mean == max == L/(nt*nk)
+            mean_f = max_f = sched[1]
         else:
             mean_f, max_f = tri_fractions(
                 grid, M, K, N, a_uplo, b_uplo, out_uplo,
@@ -745,7 +882,7 @@ def _matmul(
     if mode == "explicit":
         return _explicit_matmul(
             grid, A, B, precision, a_uplo, b_uplo, out_uplo, cyclic_rows,
-            cyclic_out,
+            cyclic_out, sched=sched,
         )
     raise ValueError(f"unknown summa mode {mode!r}")
 

@@ -258,7 +258,9 @@ def cholinv(args) -> dict:
 
 
 def cacqr(args) -> dict:
-    bc = pick_bc(args.n, args.bc, cholinv_family=False)
+    # the nested config factors the n x n GRAM — a cholinv-family workload,
+    # so the auto-pick follows the cholinv crossovers at the gram size
+    bc = pick_bc(args.n, args.bc)
     # tall-skinny topology: the reference uses a tunable rect grid
     # (topology.h:16-65); the 1d/auto regimes want the whole mesh on the
     # long axis (Grid.flat), 'dist' wants a square face

@@ -194,7 +194,16 @@ def _sweep_1d(
         # the live-tile kernel is an explicit mode choice (the bench driver's
         # 'auto' resolves to pallas on one TPU); other modes take the dense
         # matmul — on CPU the interpreter would be orders of magnitude slower
-        tri_kernel = g > 1 and grid.num_devices == 1 and cfg.mode == "pallas"
+        # nb <= 2048 is the live-tile kernel's VMEM envelope at these
+        # blocks ((bm, nb, nb) + f32 acc): nb=4096 blows Mosaic's scoped
+        # limit ("112.00M of 100.00M", n=8192) — wider shapes take the
+        # dense matmul (the CQR2 path covers them with the panel tier)
+        tri_kernel = (
+            g > 1
+            and grid.num_devices == 1
+            and cfg.mode == "pallas"
+            and n // g <= 2048
+        )
         # live_frac applies only where the tri kernel actually skips dead
         # blocks; the multi-device path executes the dense matmul
         tracing.emit(
@@ -219,6 +228,33 @@ def _sweep_1d(
     return Q, R
 
 
+def _gram_chol(grid: Grid, G: jnp.ndarray, cfg: CacqrConfig):
+    """(R, R⁻¹) of the UPPER-VALID gram, shared by every fused/panel tier.
+
+    Wide grams route through the recursive cholinv: the whole-matrix lax
+    chol+solve serializes its panel sweep (measured 10.7 ms at n=4096 ≈
+    17 TF/s); the framework's own factor does the same job in ~3.9 ms.
+    cholinv reads ONLY the upper triangle (its potrf_trtri_upper
+    base-case contract, verified bit-identical under a garbage lower
+    half), so the gram kernels' upper-block-row output feeds it with NO
+    symmetric-assembly pass; below the crossover the upper-valid factor
+    pair does the same.  The caller's nested cholinv config carries the
+    --bc knob; complete_inv is FORCED True — these tiers multiply by the
+    full triangular inverse (the partial-inverse contract is the dist
+    regime's blocked solve, solve_blocked)."""
+    n = G.shape[0]
+    if n >= 2048 and grid.num_devices == 1:
+        return cholesky.factor(
+            grid,
+            G,
+            dataclasses.replace(
+                cfg.cholinv, mode=cfg.mode, precision=cfg.precision,
+                complete_inv=True,
+            ),
+        )
+    return lapack.potrf_trtri_upper(G)
+
+
 def _cqr2_fused(
     grid: Grid, A: jnp.ndarray, cfg: CacqrConfig, g: int, plan: str = "full"
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
@@ -239,31 +275,8 @@ def _cqr2_fused(
     precision = cfg.precision
     live = qr_fused.live_fraction(g)
 
-    # wide grams: the whole-matrix lax chol+solve serializes its panel
-    # sweep (measured 10.7 ms at n=4096 ≈ 17 TF/s); the framework's own
-    # recursive cholinv with the live-tile kernels is the faster factor
-    # above the lax crossover (same single-chip pallas family the flagship
-    # runs).  cholinv reads ONLY the upper triangle (its potrf_trtri_upper
-    # base-case contract, verified bit-identical under a garbage lower
-    # half), so the gram can skip assemble_sym entirely — the kernel's
-    # upper-block-row form already holds the valid upper triangle.
-    use_cholinv = n >= 2048 and grid.num_devices == 1
-
     def _chol(G):
-        if use_cholinv:
-            # the caller's nested cholinv config (drivers wire --bc into
-            # it) with this pipeline's mode/precision — not a parallel
-            # hardcoded config that would leave the knob dead
-            return cholesky.factor(
-                grid,
-                G,
-                dataclasses.replace(
-                    cfg.cholinv, mode=cfg.mode, precision=precision
-                ),
-            )
-        # upper-valid factor pair: reads only the triangle the gram kernel
-        # wrote, so no assembly pass is needed on this branch either
-        return lapack.potrf_trtri_upper(G)
+        return _gram_chol(grid, G, cfg)
 
     def _gram_out(Gu):
         # both chol routes read only the valid upper triangle — the
@@ -299,6 +312,80 @@ def _cqr2_fused(
     with tracing.scope("CQR::formR"):
         tracing.emit(flops=2.0 * m * n * n * live)
         Q = qr_fused.scale_blocked(Q1, jnp.triu(R2inv), g=g, precision=precision)
+    with tracing.scope("CQR::merge"):
+        tracing.emit(flops=2.0 * n**3)
+        R = jnp.matmul(jnp.triu(R2), jnp.triu(R1), precision=precision)
+    return Q, R
+
+
+def _cqr2_panels(
+    grid: Grid, A: jnp.ndarray, cfg: CacqrConfig, c: int = 512
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """CQR2 for very wide n — past EVERY fused kernel's VMEM envelope
+    (qr_fused.fused_plan tier 'panels').  Pure-XLA panel pipeline with the
+    same triangular flop structure the kernels exploit:
+
+      gram:  column-panel j needs only rows [0, (j+1)c) — the product
+             X[:, :(j+1)c]ᵀ · X[:, jc:(j+1)c] IS the valid upper part,
+             zero-padded below (cholinv's upper-only read contract).
+      scale: Q[:, jc:(j+1)c] = X[:, :(j+1)c] · R⁻¹[: (j+1)c, panel]
+             (upper-triangular R⁻¹: the zero lower blocks never load).
+
+    Executed flops are (g+1)/2g of dense, like the kernels.  The extra
+    operand reads (panel j re-reads X's leading columns) that made the
+    XLA-level split a measured LOSER at n=1024 (docs/PERF.md round-2) are
+    noise here: arithmetic intensity ~n/(g+1) ≈ 512 flops/byte at n=8192,
+    far above the v5e compute/bandwidth ratio (~240) — the pipeline is
+    MXU-bound, XLA pipelines the HBM traffic under it.  The n×n gram
+    factor rides the recursive cholinv (n ≥ 2048 always holds here)."""
+    m, n = A.shape
+    g = n // c
+    precision = cfg.precision
+    live = (g + 1) / (2.0 * g)
+
+    def _chol(G):
+        return _gram_chol(grid, G, cfg)
+
+    def gram(X):
+        cols = []
+        for j in range(g):
+            P = jnp.matmul(
+                X[:, : (j + 1) * c].T, X[:, j * c : (j + 1) * c],
+                precision=precision,
+            )
+            cols.append(jnp.pad(P, ((0, n - (j + 1) * c), (0, 0))))
+        return jnp.concatenate(cols, axis=1).astype(A.dtype)
+
+    def scale(X, Rinv):
+        Rt = jnp.triu(Rinv)
+        return jnp.concatenate(
+            [
+                jnp.matmul(
+                    X[:, : (j + 1) * c],
+                    Rt[: (j + 1) * c, j * c : (j + 1) * c],
+                    precision=precision,
+                )
+                for j in range(g)
+            ],
+            axis=1,
+        ).astype(A.dtype)
+
+    with tracing.scope("CQR::gram"):
+        tracing.emit(flops=2.0 * m * n * n * live)
+        G1 = gram(A)
+    with tracing.scope("CQR::chol"):
+        tracing.emit(flops=tracing.potrf_trtri_flops(n))
+        R1, R1inv = _chol(G1)
+    with tracing.scope("CQR::fused"):
+        tracing.emit(flops=2.0 * m * n * n * (live + live))
+        Q1 = scale(A, R1inv)
+        G2 = gram(Q1)
+    with tracing.scope("CQR::chol"):
+        tracing.emit(flops=tracing.potrf_trtri_flops(n))
+        R2, R2inv = _chol(G2)
+    with tracing.scope("CQR::formR"):
+        tracing.emit(flops=2.0 * m * n * n * live)
+        Q = scale(Q1, R2inv)
     with tracing.scope("CQR::merge"):
         tracing.emit(flops=2.0 * n**3)
         R = jnp.matmul(jnp.triu(R2), jnp.triu(R1), precision=precision)
@@ -486,17 +573,35 @@ def pallas_coupled(
     route; deciding it needs the full (m, dtype) question — callers that
     cannot supply them get the conservative False (full-consumption
     coupling is always measurement-safe, just slower)."""
-    if grid.num_devices == 1:
-        return mode == "pallas" and _col_blocks(n) > 1
-    if m is None or dtype is None:
-        return False
     from capital_tpu.ops import qr_fused
 
+    if grid.num_devices == 1:
+        if mode != "pallas":
+            return False
+        if m is not None and dtype is not None:
+            # the authoritative answer: which tier does factor() route to?
+            # 'full'/'split' ride Mosaic custom calls (coupled); 'panels'
+            # is pure XLA (one-element consumption would let the
+            # simplifier drop every other panel — NOT coupled); None
+            # falls to the sweeps' tri-kernel predicate below
+            g = qr_fused.pick_g(n)
+            plan = (
+                qr_fused.fused_plan(grid, m, n, mode, g=g, dtype=dtype)
+                if g
+                else None
+            )
+            if plan is not None:
+                return plan != "panels"
+        # sweeps path (or an m/dtype-less caller, which never benches the
+        # wide shapes): the nb cap mirrors _sweep_1d's tri_kernel envelope
+        return _col_blocks(n) > 1 and n // _col_blocks(n) <= 2048
+    if m is None or dtype is None:
+        return False
     g = qr_fused.pick_g(n)
-    return bool(
-        g
-        and qr_fused.fused_plan(grid, m, n, mode, g=g, dtype=dtype) is not None
+    plan = (
+        qr_fused.fused_plan(grid, m, n, mode, g=g, dtype=dtype) if g else None
     )
+    return plan is not None and plan != "panels"
 
 
 def _pick_regime(grid: Grid, n: int, cfg: CacqrConfig) -> str:
@@ -532,7 +637,12 @@ def factor(
             if cfg.num_iter == 2 and g
             else None
         )
-        if plan:
+        if plan == "panels":
+            # pure-XLA panel pipeline: single-device wide n (the mesh 1d
+            # path never engages the crashing kernel route)
+            if grid.num_devices == 1:
+                return _cqr2_panels(grid, A, cfg)
+        elif plan:
             if grid.num_devices > 1:
                 return _cqr2_fused_sharded(grid, A, cfg, g, plan)
             return _cqr2_fused(grid, A, cfg, g, plan)

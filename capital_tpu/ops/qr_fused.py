@@ -401,6 +401,14 @@ def fused_plan(grid, m: int, n: int, mode: str, bm: int = 1024, g: int = 2,
         scale_res = 2 * bm_ok * n * item + n * n * item
         if max(gram_res, scale_res) <= limit:
             return "split"
+        if n % 512 == 0:
+            # beyond every kernel envelope: the XLA-level panel pipeline
+            # (models/qr.py _cqr2_panels) — same (g+1)/2g saving, no VMEM
+            # constraint; at these widths the pipeline is MXU-bound
+            # (arithmetic intensity ~n/(g+1) flops/byte), so the extra
+            # panel reads the round-4 n=1024 measurement rejected are
+            # noise here
+            return "panels"
         return None
 
 

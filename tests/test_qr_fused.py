@@ -179,7 +179,7 @@ class TestFusedPipeline:
         ) == "split"
         assert qr_fused.fused_plan(
             grid1, 65536, 8192, "pallas", g=64, dtype=bf
-        ) is None
+        ) == "panels"
 
     def test_wide_n_cholinv_route_matches_unfused(self, grid1):
         # n >= 2048 routes the gram factor through the recursive cholinv
@@ -200,6 +200,26 @@ class TestFusedPipeline:
         np.testing.assert_allclose(np.asarray(Qf), np.asarray(Qu), atol=1e-9)
         np.testing.assert_allclose(
             np.triu(np.asarray(Rf)), np.triu(np.asarray(Ru)), atol=1e-7
+        )
+
+    def test_panels_tier_matches_unfused(self, grid1):
+        # the very-wide-n XLA panel pipeline (fused_plan 'panels'): same
+        # grams-from-rounded-Q math as the sweeps, checked at a small
+        # shape by calling the tier directly
+        from capital_tpu.models.qr import _cqr2_panels
+
+        m, n = 2048, 1024
+        A = _tall(m, n).astype(jnp.float64)
+        cfg = CacqrConfig(num_iter=2, regime="1d", mode="pallas")
+        Qp, Rp = jax.jit(lambda a: _cqr2_panels(grid1, a, cfg, 256))(A)
+        assert float(residual.qr_orthogonality(Qp)) < 1e-14
+        assert float(residual.qr_residual(A, Qp, Rp)) < 1e-13
+        Qu, Ru = jax.jit(
+            lambda a: qr.factor(grid1, a, CacqrConfig(num_iter=2, regime="1d"))
+        )(A)
+        np.testing.assert_allclose(np.asarray(Qp), np.asarray(Qu), atol=1e-9)
+        np.testing.assert_allclose(
+            np.triu(np.asarray(Rp)), np.triu(np.asarray(Ru)), atol=1e-7
         )
 
     def test_fused_bf16_gates(self, grid1):

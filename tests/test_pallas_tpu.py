@@ -373,3 +373,42 @@ def test_cholinv_pallas_mode_aligned_views(grid1):
     # dead halves must be true zeros (mask inside the aliased writes)
     assert float(jnp.abs(jnp.tril(R, -1)).max()) == 0.0
     assert float(jnp.abs(jnp.tril(Rinv, -1)).max()) == 0.0
+
+
+class TestWriteDiagBlocks:
+    """In-place aliased diagonal-block scatter (round 5 — the rectri
+    batched-prefix write-back)."""
+
+    def test_aligned_kernel_path(self):
+        from capital_tpu.ops import pallas_tpu
+
+        rng = np.random.default_rng(0)
+        out = jnp.asarray(rng.standard_normal((512, 512)).astype(np.float32))
+        W = jnp.asarray(rng.standard_normal((4, 128, 128)).astype(np.float32))
+        # `out` is consumed (aliased donation): snapshot the expectation
+        # BEFORE the call
+        want = np.asarray(out).copy()
+        for i in range(4):
+            want[i * 128:(i + 1) * 128, i * 128:(i + 1) * 128] = np.asarray(W[i])
+        got = np.asarray(pallas_tpu.write_diag_blocks(out, W))
+        np.testing.assert_array_equal(got, want)
+
+    def test_misaligned_falls_back_to_dus(self):
+        from capital_tpu.ops import pallas_tpu
+
+        rng = np.random.default_rng(1)
+        out = jnp.asarray(rng.standard_normal((192, 192)).astype(np.float32))
+        W = jnp.asarray(rng.standard_normal((3, 64, 64)).astype(np.float32))
+        want = np.asarray(out).copy()
+        for i in range(3):
+            want[i * 64:(i + 1) * 64, i * 64:(i + 1) * 64] = np.asarray(W[i])
+        got = np.asarray(pallas_tpu.write_diag_blocks(out, W))
+        np.testing.assert_array_equal(got, want)
+
+    def test_dtype_cast_on_write(self):
+        from capital_tpu.ops import pallas_tpu
+
+        out = jnp.zeros((256, 256), jnp.bfloat16)
+        W = jnp.ones((2, 128, 128), jnp.float32) * 1.5
+        got = np.asarray(pallas_tpu.write_diag_blocks(out, W), np.float32)
+        assert got[0, 0] == 1.5 and got[255, 255] == 1.5 and got[0, 200] == 0.0

@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all test benchmarking tune native clean
+.PHONY: all test benchmarking tune audit native clean
 
 all: test
 
@@ -19,6 +19,12 @@ benchmarking:
 
 tune:
 	$(PY) -m capital_tpu.autotune cholinv --n 2048 --out autotune_out
+
+# model-vs-compiled drift gate on the flagship configs (docs/OBSERVABILITY.md);
+# compile-only — runs in CI without a TPU (exit non-zero on drift)
+audit:
+	$(PY) -m capital_tpu.obs audit cholinv --n 4096 --platform cpu
+	$(PY) -m capital_tpu.obs audit cacqr --m 16384 --n 512 --platform cpu
 
 native:
 	$(PY) -c "from capital_tpu import native; print('native engine available:', native.available())"

@@ -57,6 +57,11 @@ def main(argv=None) -> None:
     p.add_argument("--devices", type=int, default=0)
     p.add_argument("--platform", default=None)
     p.add_argument("--host-devices", type=int, default=0)
+    p.add_argument(
+        "--ledger", default=None,
+        help="append one obs ledger record per swept config to this JSONL "
+        "file (query with python -m capital_tpu.obs diff)",
+    )
     args = p.parse_args(argv)
 
     if args.host_devices:
@@ -134,7 +139,7 @@ def main(argv=None) -> None:
         )
         res = sweep.tune_cholinv(
             grid, args.n, dtype, args.out, prefilter_top_k=args.top_k,
-            checkpoint=args.resume, **space,
+            checkpoint=args.resume, ledger=args.ledger, **space,
         )
     elif args.alg == "trsm":
         # reject every non-axis rather than silently ignoring it (ADVICE r4:
@@ -157,12 +162,13 @@ def main(argv=None) -> None:
         nrhs = args.m if args.m != 65536 else args.n
         res = sweep.tune_trsm(
             grid, args.n, nrhs, dtype, args.out,
-            checkpoint=args.resume, **space,
+            checkpoint=args.resume, ledger=args.ledger, **space,
         )
     else:
         grid = Grid.flat(devices=dev)
         res = sweep.tune_cacqr(grid, args.m, args.n if args.n < args.m else 512,
-                               dtype, args.out, checkpoint=args.resume, **space)
+                               dtype, args.out, checkpoint=args.resume,
+                               ledger=args.ledger, **space)
     best = res[0]
     print(f"best: {best.config_id}  {best.seconds * 1e3:.3f} ms  -> {args.out}/")
 

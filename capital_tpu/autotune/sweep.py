@@ -152,6 +152,7 @@ def run_sweep(
     dtype=None,
     checkpoint: bool = False,
     key_extra: dict | None = None,
+    ledger: str | None = None,
 ) -> list[SweepResult]:
     """Measure + model every (config_id, config_dict, step_fn) and write the
     cost tables.  Returns results sorted best-first by measured time.
@@ -161,7 +162,12 @@ def run_sweep(
     of the same problem (shape/dtype/device/topology) resumes, skipping
     measured configs.  Unresolved (noise-floor) configs are NOT persisted —
     the condition can be a transient drift window, so every resume retries
-    them."""
+    them.
+
+    ledger=PATH additionally appends one obs ledger record per swept config
+    (manifest keyed by config_id, the Recorder model decomposition, and the
+    measured seconds) so sweeps land in the same queryable JSONL stream as
+    bench runs and audits."""
     dtype = dtype or operand.dtype
     configs = list(configs)
     if not configs:
@@ -217,6 +223,36 @@ def run_sweep(
         raise RuntimeError(
             f"autotune sweep {name!r}: no config produced a resolvable time"
         )
+    if ledger:
+        from capital_tpu.obs import ledger as obs_ledger
+
+        extra = dict(key_extra or {})
+        # key_extra's "grid" is already a repr string — it must not bind
+        # manifest()'s grid parameter (which expects a Grid object)
+        grid_repr = extra.pop("grid", None)
+        for r in results:
+            man = obs_ledger.manifest(
+                dtype=dtype, config=r.config, config_id=r.config_id,
+                shape=list(operand.shape), **extra,
+            )
+            if grid_repr is not None:
+                man["grid"] = grid_repr
+            obs_ledger.append(
+                ledger,
+                obs_ledger.record(
+                    f"autotune:{name}",
+                    man,
+                    model=obs_ledger.model_costs(r.recorder, dtype=dtype),
+                    # value is rate (1/s), not seconds: diff() flags VALUE
+                    # drops, and "slower" must read as a drop
+                    measured={
+                        "metric": f"{name}_sweep",
+                        "value": round(1.0 / r.seconds, 4),
+                        "unit": "iter/s",
+                        "seconds": r.seconds,
+                    },
+                ),
+            )
     results.sort(key=lambda r: r.seconds)
     best = results[0]
     with open(os.path.join(out_dir, f"{name}_best.json"), "w") as f:
@@ -423,6 +459,7 @@ def tune_trsm(
     dtype=jnp.bfloat16,
     out_dir: str = "autotune_out",
     checkpoint: bool = False,
+    ledger: str | None = None,
     **space,
 ) -> list[SweepResult]:
     from capital_tpu.bench.drivers import _tri_operand
@@ -441,6 +478,7 @@ def tune_trsm(
     return run_sweep(
         "trsm", trsm_space(grid, dtype, L, **space), B, out_dir, dtype=dtype,
         checkpoint=checkpoint, key_extra={**_grid_key(grid), "n": n},
+        ledger=ledger,
     )
 
 
@@ -451,6 +489,7 @@ def tune_cholinv(
     out_dir: str = "autotune_out",
     prefilter_top_k: int = 0,
     checkpoint: bool = False,
+    ledger: str | None = None,
     **space,
 ) -> list[SweepResult]:
     """Sweep cholinv configs.  With prefilter_top_k > 0, the native
@@ -501,7 +540,7 @@ def tune_cholinv(
         configs = kept
     return run_sweep(
         "cholinv", configs, A, out_dir, dtype=dtype, checkpoint=checkpoint,
-        key_extra=_grid_key(grid),
+        key_extra=_grid_key(grid), ledger=ledger,
     )
 
 
@@ -512,6 +551,7 @@ def tune_cacqr(
     dtype=jnp.bfloat16,
     out_dir: str = "autotune_out",
     checkpoint: bool = False,
+    ledger: str | None = None,
     **space,
 ) -> list[SweepResult]:
     A = jax.block_until_ready(
@@ -519,5 +559,5 @@ def tune_cacqr(
     )
     return run_sweep(
         "cacqr", cacqr_space(grid, dtype, **space), A, out_dir, dtype=dtype,
-        checkpoint=checkpoint, key_extra=_grid_key(grid),
+        checkpoint=checkpoint, key_extra=_grid_key(grid), ledger=ledger,
     )

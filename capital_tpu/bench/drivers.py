@@ -42,11 +42,69 @@ def _tolerance(dtype) -> float:
     return {2: 5e-2, 4: 5e-5, 8: 1e-13}[jnp.dtype(dtype).itemsize]
 
 
+#: Residual gate values of the current driver invocation, keyed by gate
+#: name — snapshotted and cleared by _ledger_append at the end of each
+#: driver, so --validate runs carry their numerics in the same ledger
+#: record as the timing and the audit (suite runs several drivers in one
+#: process; values must not bleed across rows).
+_RESIDUALS: dict[str, float] = {}
+
+
 def _gate(name: str, value: float, tol: float) -> None:
+    _RESIDUALS[name] = value
     ok = value < tol
     print(f"# validate {name} = {value:.3e} (tol {tol:.0e}) {'OK' if ok else 'FAIL'}")
     if not ok:
         sys.exit(f"validation failed: {name} = {value:.3e} >= {tol:.0e}")
+
+
+def _ledger_append(
+    args, rec: dict, *, name: str, grid: Grid, cfg=None, step=None,
+    operand=None, dtype=None,
+) -> None:
+    """Append one unified ledger record for a finished driver run (opt-in
+    via --ledger PATH; no-op otherwise).  `name` is the driver's own name —
+    args.driver says "suite" for suite rows.
+
+    The record carries the measured JSON line plus, when the driver can
+    hand over its (step, operand), the Recorder model decomposition and the
+    compiled-program audit + drift report — the same facts
+    ``python -m capital_tpu.obs audit`` derives, attached to a real
+    measurement.  Model/audit capture is best-effort: a config whose
+    re-lowering fails (e.g. a mode unsupported on this backend) still gets
+    its manifest + measurement + residuals recorded, with the error noted,
+    rather than losing the run."""
+    residuals = dict(_RESIDUALS)
+    _RESIDUALS.clear()
+    path = getattr(args, "ledger", None)
+    if not path:
+        return
+    from capital_tpu.obs import ledger, xla_audit
+
+    model = audit_d = drift_d = None
+    err = None
+    if step is not None and operand is not None:
+        op_args = operand if isinstance(operand, tuple) else (operand,)
+        try:
+            recd = xla_audit.trace_model(step, *op_args)
+            audit = xla_audit.audit(step, *op_args)
+            rep = xla_audit.drift(audit, recd)
+            model = ledger.model_costs(recd, dtype=dtype)
+            audit_d = audit.asdict()
+            drift_d = rep.asdict()
+        except Exception as e:  # noqa: BLE001 — ledger must not fail the run
+            err = f"{type(e).__name__}: {e}"
+    row = ledger.record(
+        f"bench:{name}",
+        ledger.manifest(grid=grid, dtype=dtype, config=cfg),
+        model=model,
+        audit=audit_d,
+        drift=drift_d,
+        measured=rec,
+        residuals=residuals or None,
+        **({"audit_error": err} if err else {}),
+    )
+    ledger.append(path, row)
 
 
 def _spd(n: int, dtype, seed: int = 0) -> jnp.ndarray:
@@ -254,6 +312,10 @@ def cholinv(args) -> dict:
                 float(residual.cholesky_inverse_residual(R, Rinv)),
                 tol,
             )
+    _ledger_append(
+        args, rec, name="cholinv", grid=grid, cfg=cfg, step=step, operand=A,
+        dtype=dtype,
+    )
     return rec
 
 
@@ -314,6 +376,10 @@ def cacqr(args) -> dict:
         )
         extra = {"oneshot": True, "regen_seconds": round(t_regen, 5), **extra}
         A = None
+        # the ledger audit lowers against an abstract operand — a concrete
+        # A is exactly what the one-shot protocol exists to avoid holding
+        step = scalar_step
+        audit_operand = jax.ShapeDtypeStruct((args.m, args.n), dtype)
     else:
         # generate on device directly at the target dtype (an f32 staging
         # buffer alone is 8GB at the 2M x 1024 BASELINE shape)
@@ -334,6 +400,7 @@ def cacqr(args) -> dict:
         # predicate lives in qr next to the kernel gating it must track
         coupling = "elem" if elem_ok else "full"
         t, extra = _timed(args, step, A, coupling=coupling)
+        audit_operand = A
     # useful flops per sweep: gram mn² + Q·R⁻¹ mn²; CQR2 doubles the sweeps
     flops = 2.0 * args.m * args.n**2 * cfg.num_iter
     rec = harness.report(
@@ -356,6 +423,10 @@ def cacqr(args) -> dict:
             float(jax.jit(residual.qr_residual_blocked)(A, Q, R)),
             tol,
         )
+    _ledger_append(
+        args, rec, name="cacqr", grid=grid, cfg=cfg, step=step,
+        operand=audit_operand, dtype=dtype,
+    )
     return rec
 
 
@@ -384,6 +455,10 @@ def summa_gemm(args) -> dict:
         ref = jnp.matmul(A.astype(jnp.float32), B.astype(jnp.float32))
         err = float(residual.rel_fro(C.astype(jnp.float32) - ref, ref))
         _gate("gemm_residual", err, _tolerance(dtype))
+    _ledger_append(
+        args, rec, name="summa_gemm", grid=grid, cfg=gargs, step=step,
+        operand=A, dtype=dtype,
+    )
     return rec
 
 
@@ -437,6 +512,10 @@ def rectri(args) -> dict:
             float(jax.jit(residual.inverse_residual_blocked)(L, Linv)),
             _tolerance(dtype),
         )
+    _ledger_append(
+        args, rec, name="rectri", grid=grid, cfg=cfg, step=step, operand=L,
+        dtype=dtype,
+    )
     return rec
 
 
@@ -476,6 +555,10 @@ def newton(args) -> dict:
             float(residual.inverse_residual(A, Ainv)),
             10 * _tolerance(dtype),
         )
+    _ledger_append(
+        args, rec, name="newton", grid=grid, cfg=cfg, step=step, operand=A,
+        dtype=dtype,
+    )
     return rec
 
 
@@ -506,6 +589,10 @@ def spd_inverse(args) -> dict:
             float(residual.inverse_residual(A, Ainv)),
             10 * _tolerance(dtype),
         )
+    _ledger_append(
+        args, rec, name="spd_inverse", grid=grid, cfg=cfg, step=step,
+        operand=A, dtype=dtype,
+    )
     return rec
 
 
@@ -609,6 +696,18 @@ def trsm(args) -> dict:
             jax.jit(lambda t, b: combo_err(t, b, "L", "L", True))(L, Bv)
         )
         _gate("trsm_residual_unit_diag", err, tol)
+
+    # audit step takes (L, B) as REAL arguments (same HLO-constant rule as
+    # the timing loop above); skipped past n=8192 where re-lowering the
+    # whole solve just for the inventory costs more than the bench itself
+    def audit_step(lo, b):
+        return trsm_mod.solve(grid, lo, b, side="L", uplo="L", cfg=cfg)
+
+    _ledger_append(
+        args, rec, name="trsm", grid=grid, cfg=cfg,
+        step=audit_step if args.n <= 8192 else None, operand=(L, B),
+        dtype=dtype,
+    )
     return rec
 
 
@@ -681,6 +780,12 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--no-complete-inv", action="store_true")
     p.add_argument("--validate", action="store_true")
+    p.add_argument(
+        "--ledger", default=None,
+        help="append one unified obs ledger record per run (manifest + "
+        "model costs + compiled-program audit + measured + residuals) to "
+        "this JSONL file; query with python -m capital_tpu.obs diff",
+    )
     p.add_argument("--scale", type=int, default=1, help="suite: divide problem sizes")
     p.add_argument(
         "--platform", default=None,

@@ -318,18 +318,26 @@ def report(
     device=None,
     **context,
 ) -> dict:
-    """Print + return the one-line JSON record."""
+    """Print + return the one-line JSON record.
+
+    schema_version/device/platform make the line self-identifying so
+    ``obs diff`` can refuse to compare records from incompatible schemas
+    or different chips (docs/OBSERVABILITY.md)."""
+    from capital_tpu.obs.ledger import SCHEMA_VERSION
+
     device = device or jax.devices()[0]
     tflops = flops / seconds / 1e12
     target = 0.9 * peak_tflops(device, dtype)
     rec = {
         "metric": metric,
+        "schema_version": SCHEMA_VERSION,
         "value": round(tflops, 3),
         "unit": "TFLOP/s",
         "vs_baseline": round(tflops / target, 4),
         "seconds": round(seconds, 5),
         "dtype": str(jnp.dtype(dtype)),
         "device": device.device_kind,
+        "platform": jax.default_backend(),
         "target_tflops": round(target, 1),
         **context,
     }

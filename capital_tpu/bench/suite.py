@@ -12,6 +12,12 @@ Multi-device configs run when the platform has enough devices (real chips,
 or a CPU mesh under --xla_force_host_platform_device_count); otherwise they
 fall back to all available devices and say so.  --scale divides the problem
 sizes for smoke runs on the test rig.
+
+Every row inherits the base argv via _args, so ``--ledger PATH`` on the
+suite invocation makes each driver append its unified obs ledger record
+(manifest + model costs + program audit + measured + residuals) — one
+``python -m capital_tpu.bench suite --ledger runs.jsonl`` captures the
+whole BASELINE set for later ``python -m capital_tpu.obs diff``.
 """
 
 from __future__ import annotations

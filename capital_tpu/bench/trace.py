@@ -37,17 +37,22 @@ import tempfile
 import jax
 import jax.numpy as jnp
 
+from capital_tpu.utils import tracing
 
-#: flagship phase buckets, innermost-first.  An op whose metadata mentions
-#: none of these lands in 'copy' / 'fusion' / 'other' by HLO kind — the
+
+def _phase_tags() -> tuple[str, ...]:
+    """Named-scope (dot) forms of the registered phase tags.  Derived from
+    tracing.PHASE_REGISTRY — the single source of truth — so a phase added
+    to scope() can never be silently bucketed into 'other' here (the fate
+    of RT::batch_write under the old hardcoded copy of this tuple).
+    Re-evaluated lazily so in-process register_phase() calls are seen."""
+    return tuple(t.replace("::", ".") for t in tracing.PHASE_REGISTRY)
+
+
+#: flagship phase buckets (dot form).  An op whose metadata mentions none
+#: of these lands in 'copy' / 'fusion' / 'other' by HLO kind — the
 #: catch-alls that caught the round-2 relayout-copy regressions.
-PHASE_TAGS = (
-    "CI.factor_diag", "CI.trsm", "CI.tmu", "CI.inv",
-    "CQR.gram", "CQR.chol", "CQR.scale", "CQR.merge", "CQR.fused",
-    "CQR.formR",
-    "RT.base", "RT.merge", "RT.batch_base", "RT.batch_merge",
-    "TS.dinv", "TS.leaf", "TS.update",
-)
+PHASE_TAGS = _phase_tags()
 
 
 def _own_times(line):
@@ -115,7 +120,7 @@ def _bucket(md, stat_metadata) -> str:
 
     def match(hay: str) -> str | None:
         best = None
-        for tag in PHASE_TAGS:
+        for tag in _phase_tags():
             if tag in hay and (best is None or len(tag) > len(best)):
                 best = tag
         return best

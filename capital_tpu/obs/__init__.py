@@ -1,0 +1,22 @@
+"""Unified observability layer: XLA program audits + the run ledger.
+
+One queryable record format over what used to be four disconnected views:
+
+* the analytic alpha-beta cost model (utils/tracing.Recorder),
+* compiled-program facts (collective inventory, flops/bytes, peak HBM —
+  obs/xla_audit.ProgramAudit),
+* measured wall time (bench/harness JSON lines),
+* residual gates (bench/drivers --validate).
+
+`xla_audit` promotes the HLO collective inventory out of
+tests/test_collective_audit.py into a library and adds the
+model-vs-compiled drift classifier; `ledger` defines the versioned JSONL
+record every bench/autotune run can append (--ledger PATH) and the diff
+engine that flags regressions between two ledgers.  The CLI lives in
+``python -m capital_tpu.obs`` (audit / diff subcommands); the schema and
+tolerance policy are documented in docs/OBSERVABILITY.md.
+"""
+
+from capital_tpu.obs import ledger, xla_audit  # noqa: F401
+
+__all__ = ["ledger", "xla_audit"]

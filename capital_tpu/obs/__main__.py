@@ -1,0 +1,230 @@
+"""CLI: ``python -m capital_tpu.obs {audit,diff} ...``
+
+``audit`` runs a driver config through the model trace + compiled-program
+audit and prints the drift report plus ONE ledger JSON record (appended to
+--ledger when given); it exits non-zero on out-of-tolerance drift, so
+``make audit`` is a CI gate that needs no TPU (compile-only: nothing is
+executed or timed).
+
+``diff`` compares two ledger JSONL files and exits non-zero when a measured
+metric, collective count, or peak-HBM regression beyond tolerance appears;
+exit 2 means the ledgers are not comparable (schema/device mismatch).
+
+Examples::
+
+    python -m capital_tpu.obs audit cholinv --n 4096
+    python -m capital_tpu.obs audit cacqr --m 65536 --n 512 --ledger runs.jsonl
+    python -m capital_tpu.obs diff baseline.jsonl current.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import jax
+
+
+def _build(algo: str, args, grid):
+    """(step, operand, cfg, dtype) for one driver config — the same
+    construction the bench drivers use, minus the measurement loop."""
+    import jax.numpy as jnp
+
+    from capital_tpu.bench import drivers
+    from capital_tpu.models import cholesky, inverse, qr, trsm as trsm_mod
+    from capital_tpu.parallel import summa
+
+    dtype = jnp.dtype(args.dtype)
+    mode = drivers._resolve_mode(args.mode, grid)
+    prec = drivers._precision(args, dtype)
+    if algo in ("cholinv", "spd_inverse"):
+        bc = drivers.pick_bc(args.n, args.bc)
+        cfg = cholesky.CholinvConfig(base_case_dim=bc, mode=mode, precision=prec)
+        A = drivers._spd(args.n, dtype)
+        if algo == "cholinv":
+            def step(a):
+                R, Rinv = cholesky.factor(grid, a, cfg)
+                return R + Rinv
+        else:
+            def step(a):
+                return cholesky.spd_inverse(grid, a, cfg)
+        return step, A, cfg, dtype
+    if algo == "cacqr":
+        bc = drivers.pick_bc(args.n, args.bc)
+        cfg = qr.CacqrConfig(
+            num_iter=args.variant, regime=args.regime, mode=mode,
+            cholinv=cholesky.CholinvConfig(
+                base_case_dim=bc, mode=mode, precision=prec
+            ),
+            precision=prec,
+        )
+        A = jax.block_until_ready(
+            jax.random.normal(jax.random.key(0), (args.m, args.n), dtype=dtype)
+        )
+
+        def step(a):
+            Q, R = qr.factor(grid, a, cfg)
+            return Q.at[: R.shape[0], : R.shape[1]].add(R.astype(Q.dtype))
+
+        return step, A, cfg, dtype
+    if algo == "rectri":
+        bc = drivers.pick_bc(args.n, args.bc, cholinv_family=False)
+        cfg = inverse.RectriConfig(base_case_dim=bc, mode=mode, precision=prec)
+        L = drivers._tri_operand(args.n, dtype)
+
+        def step(a):
+            return inverse.rectri(grid, a, "L", cfg)
+
+        return step, L, cfg, dtype
+    if algo == "trsm":
+        bc = drivers.pick_bc(args.n, args.bc, cholinv_family=False)
+        cfg = trsm_mod.TrsmConfig(base_case_dim=bc, mode=mode, precision=prec)
+        L = drivers._tri_operand(args.n, dtype)
+        nrhs = min(args.m, args.n)
+        B = jax.block_until_ready(
+            jax.random.normal(jax.random.key(1), (args.n, nrhs), dtype=dtype)
+        )
+
+        def step(lo, b):
+            return trsm_mod.solve(grid, lo, b, side="L", uplo="L", cfg=cfg)
+
+        return step, (L, B), cfg, dtype
+    if algo == "summa_gemm":
+        gargs = summa.GemmArgs(precision=prec)
+        A = jax.random.normal(jax.random.key(0), (args.n, args.n), dtype)
+
+        def step(a):
+            return summa.gemm(grid, a, a, args=gargs, mode=mode)
+
+        return step, A, gargs, dtype
+    raise SystemExit(f"unknown audit target {algo!r}")
+
+
+def _audit(args) -> int:
+    import jax.numpy as jnp  # noqa: F401  (dtype resolution inside _build)
+
+    from capital_tpu.bench import drivers
+    from capital_tpu.obs import ledger, xla_audit
+
+    grid = drivers._grid(args)
+    step, operand, cfg, dtype = _build(args.algo, args, grid)
+    op_args = operand if isinstance(operand, tuple) else (operand,)
+    rec = xla_audit.trace_model(step, *op_args)
+    audit = xla_audit.audit(step, *op_args)
+    rep = xla_audit.drift(
+        audit, rec, tol_ratio=args.tol_ratio, slack=args.slack,
+        flops_tol_ratio=args.flops_tol,
+    )
+    for line in rep.lines():
+        print(f"# {line}")
+    row = ledger.record(
+        f"audit:{args.algo}",
+        ledger.manifest(
+            grid=grid, dtype=dtype, config=cfg,
+            n=args.n, m=args.m, mode=drivers._resolve_mode(args.mode, grid),
+        ),
+        model=ledger.model_costs(rec, dtype=dtype),
+        audit=audit.asdict(),
+        drift=rep.asdict(),
+    )
+    print(json.dumps(row))
+    if args.ledger:
+        ledger.append(args.ledger, row)
+    if not rep.ok and not args.no_strict:
+        print("# drift out of tolerance (use --no-strict to report only)",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+def _diff(args) -> int:
+    from capital_tpu.obs import ledger
+
+    a = ledger.read(args.a)
+    b = ledger.read(args.b)
+    try:
+        regs = ledger.diff(
+            a, b, tol_metric=args.tol_metric, tol_hbm=args.tol_hbm,
+            tol_collective=args.tol_collective,
+        )
+    except ledger.LedgerIncompatible as e:
+        print(f"incomparable ledgers: {e}", file=sys.stderr)
+        return 2
+    for r in regs:
+        print(r.line())
+    if regs:
+        return 1
+    print(f"# no regressions ({len(a)} vs {len(b)} records)")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="capital_tpu.obs")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    a = sub.add_parser("audit", help="model-vs-compiled drift check")
+    a.add_argument(
+        "algo",
+        choices=["cholinv", "cacqr", "rectri", "trsm", "spd_inverse",
+                 "summa_gemm"],
+    )
+    a.add_argument("--n", type=int, default=4096)
+    a.add_argument("--m", type=int, default=65536)
+    a.add_argument("--bc", type=int, default=0)
+    a.add_argument("--dtype", default="bfloat16")
+    a.add_argument("--mode", default="auto",
+                   choices=["auto", "xla", "explicit", "pallas"])
+    a.add_argument("--variant", type=int, default=2)
+    a.add_argument("--regime", default="auto", choices=["auto", "1d", "dist"])
+    a.add_argument("--c", type=int, default=1)
+    a.add_argument("--devices", type=int, default=0)
+    a.add_argument("--layout", type=int, default=0, choices=[0, 1, 2])
+    a.add_argument("--chunks", type=int, default=0)
+    a.add_argument("--precision", default=None,
+                   choices=["default", "high", "highest"])
+    a.add_argument("--ledger", default=None,
+                   help="append the record to this JSONL ledger")
+    a.add_argument("--tol-ratio", type=float, default=4.0,
+                   help="per-phase compiled/model collective allowance")
+    a.add_argument("--slack", type=int, default=8,
+                   help="absolute per-phase collective allowance")
+    a.add_argument("--flops-tol", type=float, default=2.0,
+                   help="whole-program flops ratio allowance")
+    a.add_argument("--no-strict", action="store_true",
+                   help="report drift without failing the process")
+    a.add_argument("--platform", default=None)
+    a.add_argument("--host-devices", type=int, default=0)
+    a.set_defaults(fn=_audit)
+
+    d = sub.add_parser("diff", help="compare two ledger JSONL files")
+    d.add_argument("a")
+    d.add_argument("b")
+    d.add_argument("--tol-metric", type=float, default=0.10)
+    d.add_argument("--tol-hbm", type=float, default=0.05)
+    d.add_argument("--tol-collective", type=int, default=0)
+    d.set_defaults(fn=_diff)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if getattr(args, "host_devices", 0):
+        import os
+
+        flags = [
+            f
+            for f in os.environ.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f
+        ]
+        flags.append(
+            f"--xla_force_host_platform_device_count={args.host_devices}"
+        )
+        os.environ["XLA_FLAGS"] = " ".join(flags)
+    if getattr(args, "platform", None):
+        jax.config.update("jax_platforms", args.platform)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

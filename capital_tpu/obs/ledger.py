@@ -1,0 +1,278 @@
+"""The versioned JSONL run ledger — one record per run, queryable forever.
+
+Every benchmark, autotune trial, and audit can append ONE structured record
+here (the ``--ledger PATH`` opt-in), carrying together what used to live in
+four disconnected places:
+
+* a **manifest** — schema_version, device kind, platform, mesh/grid, dtype,
+  config dataclass dump, jax version — enough to refuse apples-to-oranges
+  comparisons;
+* the Recorder's per-phase **model costs** (flops / comm bytes /
+  collectives, the alpha-beta decomposition);
+* the compiled-program **audit** (collective inventory, flops, peak HBM —
+  obs/xla_audit.ProgramAudit) and its **drift** report;
+* **measured** wall-clock results (the harness JSON line: TFLOP/s,
+  achieved-vs-target fraction, seconds);
+* **residuals** when ``--validate`` ran.
+
+`diff(a, b)` compares two ledgers record-by-record (matched on a stable
+config key) and returns the regressions: measured-throughput drops,
+collective-count increases, and peak-HBM growth beyond tolerance.  Records
+with mismatched schema_version or device kind raise `LedgerIncompatible`
+rather than producing a silent garbage comparison.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+import os
+from typing import Any, Iterable, Optional
+
+import jax
+
+from capital_tpu.utils import tracing
+
+#: Bump on any breaking change to the record layout.  diff() refuses to
+#: compare records of different schema versions.
+SCHEMA_VERSION = 1
+
+
+class LedgerIncompatible(RuntimeError):
+    """Two ledger records cannot be meaningfully compared (schema_version or
+    device-kind mismatch)."""
+
+
+# --------------------------------------------------------------------------
+# record construction
+# --------------------------------------------------------------------------
+
+
+def _jsonable(obj: Any) -> Any:
+    """Best-effort JSON coercion for config dataclass dumps: enums by name,
+    dtypes/callables/devices by str."""
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return {
+            "__class__": type(obj).__name__,
+            **{
+                f.name: _jsonable(getattr(obj, f.name))
+                for f in dataclasses.fields(obj)
+            },
+        }
+    if isinstance(obj, enum.Enum):
+        return obj.name
+    if isinstance(obj, dict):
+        return {str(k): _jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(v) for v in obj]
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    return str(obj)
+
+
+def manifest(
+    grid=None, dtype=None, config=None, **extra
+) -> dict:
+    """The run manifest: everything needed to decide whether two records
+    are comparable, plus the config that produced the run."""
+    dev = jax.devices()[0]
+    man = {
+        "schema_version": SCHEMA_VERSION,
+        "jax_version": jax.__version__,
+        "platform": jax.default_backend(),
+        "device": getattr(dev, "device_kind", dev.platform),
+        "num_devices": len(jax.devices()),
+        "grid": repr(grid) if grid is not None else None,
+        "dtype": str(jax.numpy.dtype(dtype)) if dtype is not None else None,
+        "config": _jsonable(config) if config is not None else None,
+    }
+    if grid is not None:
+        man["grid_shape"] = [grid.dx, grid.dy, grid.c]
+    man.update(_jsonable(extra))
+    return man
+
+
+def model_costs(
+    rec: tracing.Recorder,
+    spec: Optional[tracing.DeviceSpec] = None,
+    dtype=None,
+) -> dict:
+    """The Recorder's decomposition as a JSON block: per-phase raw costs
+    plus the alpha-beta second estimates when a dtype is given."""
+    out: dict = {
+        "phases": {
+            tag: dataclasses.asdict(s) for tag, s in rec.stats.items()
+        },
+        "totals": dataclasses.asdict(rec.total()),
+    }
+    if dtype is not None:
+        est = rec.estimate_seconds(spec or tracing.device_spec(), dtype)
+        out["estimate_s"] = {
+            tag: {"comp_s": c, "comm_s": m} for tag, (c, m) in est.items()
+        }
+    return out
+
+
+def record(
+    kind: str,
+    man: dict,
+    *,
+    model: Optional[dict] = None,
+    audit: Optional[dict] = None,
+    drift: Optional[dict] = None,
+    measured: Optional[dict] = None,
+    residuals: Optional[dict] = None,
+    **extra,
+) -> dict:
+    """Assemble one ledger record.  `man` comes from manifest(); `model`
+    from model_costs(); `audit`/`drift` from ProgramAudit.asdict() /
+    DriftReport.asdict(); `measured` is the harness.report JSON line;
+    `residuals` maps gate name -> value."""
+    rec = {
+        "record": "capital_tpu.ledger",
+        "kind": kind,
+        "manifest": man,
+        "model": model,
+        "audit": audit,
+        "drift": drift,
+        "measured": measured,
+        "residuals": residuals,
+    }
+    rec.update(_jsonable(extra))
+    return rec
+
+
+def append(path: str, rec: dict) -> None:
+    """Append one record as a JSON line (creating parent dirs)."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+def read(path: str) -> list[dict]:
+    """Load every record of a JSONL ledger (blank lines skipped)."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+# --------------------------------------------------------------------------
+# diff
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Regression:
+    """One out-of-tolerance change between matched records."""
+
+    key: str
+    field: str
+    a: float
+    b: float
+    note: str
+
+    def line(self) -> str:
+        return f"REGRESSION {self.key} {self.field}: {self.a} -> {self.b} ({self.note})"
+
+
+def _key(rec: dict) -> str:
+    """Stable identity of what a record measured: kind + problem shape +
+    topology + dtype + config id.  Two runs sharing a key are comparable
+    trials of the same configuration."""
+    man = rec.get("manifest") or {}
+    meas = rec.get("measured") or {}
+    cfg = man.get("config") or {}
+    parts = [
+        rec.get("kind", "?"),
+        meas.get("metric") or "",
+        man.get("grid") or "",
+        man.get("dtype") or "",
+        str(cfg.get("__class__", "")),
+        str(man.get("config_id", "")),
+    ]
+    for dim in ("n", "m", "k", "nrhs", "variant", "bc", "mode"):
+        if dim in meas:
+            parts.append(f"{dim}={meas[dim]}")
+        elif dim in man:
+            parts.append(f"{dim}={man[dim]}")
+    return " ".join(p for p in parts if p)
+
+
+def _check_comparable(a: dict, b: dict) -> None:
+    ma, mb = a.get("manifest") or {}, b.get("manifest") or {}
+    # legacy bare harness lines carry schema_version at top level
+    sa = ma.get("schema_version", a.get("schema_version"))
+    sb = mb.get("schema_version", b.get("schema_version"))
+    if sa != sb:
+        raise LedgerIncompatible(
+            f"schema_version mismatch: {sa!r} vs {sb!r} — re-run the older "
+            "side with the current tooling rather than comparing across "
+            "schema changes"
+        )
+    da = ma.get("device", a.get("device"))
+    db = mb.get("device", b.get("device"))
+    if da != db:
+        raise LedgerIncompatible(
+            f"device-kind mismatch: {da!r} vs {db!r} — cross-device "
+            "comparisons are not regressions; use separate ledgers"
+        )
+
+
+def diff(
+    a_recs: Iterable[dict],
+    b_recs: Iterable[dict],
+    tol_metric: float = 0.10,
+    tol_hbm: float = 0.05,
+    tol_collective: int = 0,
+) -> list[Regression]:
+    """Regressions going from ledger `a` (baseline) to ledger `b`.
+
+    * measured value (e.g. TFLOP/s): b below a by more than tol_metric;
+    * collective counts by kind: b above a by more than tol_collective;
+    * peak HBM: b above a by more than tol_hbm (fractional).
+
+    Only keys present in BOTH ledgers are compared (a missing row is a
+    coverage change, not a regression); multiple records per key compare
+    last-against-last (the ledger is append-ordered, so the last record is
+    the freshest trial)."""
+    a_by = {_key(r): r for r in a_recs}
+    b_by = {_key(r): r for r in b_recs}
+    out: list[Regression] = []
+    for key in sorted(set(a_by) & set(b_by)):
+        a, b = a_by[key], b_by[key]
+        _check_comparable(a, b)
+        am, bm = a.get("measured") or {}, b.get("measured") or {}
+        av, bv = am.get("value"), bm.get("value")
+        if av and bv and bv < av * (1.0 - tol_metric):
+            out.append(
+                Regression(
+                    key, "measured.value", av, bv,
+                    f"{am.get('unit', '')} dropped >{tol_metric:.0%}",
+                )
+            )
+        aa, ba = a.get("audit") or {}, b.get("audit") or {}
+        for kind, ac in (aa.get("collective_counts") or {}).items():
+            bc = (ba.get("collective_counts") or {}).get(kind)
+            if bc is not None and bc > ac + tol_collective:
+                out.append(
+                    Regression(
+                        key, f"collectives.{kind}", ac, bc,
+                        "compiled program gained collectives",
+                    )
+                )
+        ah, bh = aa.get("peak_hbm_bytes"), ba.get("peak_hbm_bytes")
+        if ah and bh and bh > ah * (1.0 + tol_hbm):
+            out.append(
+                Regression(
+                    key, "peak_hbm_bytes", ah, bh,
+                    f"peak memory grew >{tol_hbm:.0%}",
+                )
+            )
+    return out

@@ -55,12 +55,19 @@ import jax.numpy as jnp
 @dataclasses.dataclass(frozen=True)
 class DeviceSpec:
     """Per-chip hardware model: peak matmul throughput + interconnect/memory
-    bandwidth.  The analog of the alpha-beta machine parameters critter fits."""
+    bandwidth.  The analog of the alpha-beta machine parameters critter fits.
+
+    ``alpha_s`` is the per-collective launch/synchronization latency — the
+    alpha of the alpha-beta model (CA-CQR2's S term, arXiv:1710.08471 §2).
+    Public ICI latencies sit around a microsecond; the CPU rig's in-process
+    ring is priced the same order (it only matters for relative ranking
+    there)."""
 
     name: str
     peak_bf16_tflops: float
     hbm_gbps: float
     ici_gbps: float  # per-direction aggregate ICI bandwidth per chip
+    alpha_s: float = 1e-6  # per-collective latency (seconds)
 
     def peak_tflops(self, dtype) -> float:
         if jnp.dtype(dtype).itemsize >= 4:
@@ -93,6 +100,41 @@ def device_spec(device: Optional[jax.Device] = None) -> DeviceSpec:
 # --------------------------------------------------------------------------
 # phase scopes + recorder
 # --------------------------------------------------------------------------
+
+#: The single source of truth for phase tags (critter symbol names).  Every
+#: `scope()` tag must be registered here: the trace tool's device-time
+#: buckets (bench/trace.py PHASE_TAGS) and the obs drift classifier both
+#: derive from this tuple, so an unregistered tag would silently land in
+#: 'other' in every downstream view — scope() refuses it instead.
+#: Innermost-first ordering is not required (matching is longest-tag-first
+#: downstream); grouping by algorithm keeps the registry reviewable.
+PHASE_REGISTRY: tuple[str, ...] = (
+    # cholinv (cholesky.py, reference cholinv.hpp:94-136)
+    "CI::factor_diag", "CI::trsm", "CI::tmu", "CI::inv",
+    # cacqr (qr.py, reference cacqr.hpp:82-116; CQR::scale is historical —
+    # kept so old traces/ledgers still bucket)
+    "CQR::gram", "CQR::chol", "CQR::scale", "CQR::merge", "CQR::fused",
+    "CQR::formR",
+    # rectri (inverse.py)
+    "RT::base", "RT::merge", "RT::batch_base", "RT::batch_merge",
+    "RT::batch_write",
+    # trsm (trsm.py)
+    "TS::dinv", "TS::leaf", "TS::update",
+)
+_PHASE_SET: set[str] = set(PHASE_REGISTRY)
+
+
+def register_phase(tag: str) -> str:
+    """Register an out-of-tree phase tag so `scope()` accepts it.  Returns
+    the tag for inline use.  Downstream tooling picks it up through
+    `PHASE_REGISTRY` on next import — in-process registrations extend the
+    live set immediately."""
+    global PHASE_REGISTRY
+    if tag not in _PHASE_SET:
+        PHASE_REGISTRY = PHASE_REGISTRY + (tag,)
+        _PHASE_SET.add(tag)
+    return tag
+
 
 _SCOPE_STACK: list[str] = []
 _ACTIVE: list["Recorder"] = []
@@ -139,8 +181,17 @@ def scope(tag: str):
     """Enter an algorithm phase: named XLA scope + cost-model attribution.
 
     Tags follow the reference's symbol names (``CI::trsm``, ``CQR::gram``,
-    cholinv.hpp:94-136, cacqr.hpp:82-116).
+    cholinv.hpp:94-136, cacqr.hpp:82-116) and must be registered in
+    `PHASE_REGISTRY` (or via `register_phase`): the device-trace tool and
+    the drift classifier bucket by the registry, so an unknown tag would
+    silently report under 'other' — refused here at trace time instead.
     """
+    if tag not in _PHASE_SET:
+        raise ValueError(
+            f"unregistered phase tag {tag!r}: add it to "
+            "tracing.PHASE_REGISTRY (or register_phase) so the trace tool "
+            "and drift classifier can bucket it"
+        )
     _SCOPE_STACK.append(tag)
     try:
         with jax.named_scope(tag.replace("::", ".")):
@@ -216,12 +267,18 @@ class Recorder:
     ) -> dict[str, tuple[float, float]]:
         """Per-phase (comp_s, comm_s) estimates from the device model.
 
-        efficiency derates peak matmul throughput (achievable fraction)."""
+        efficiency derates peak matmul throughput (achievable fraction).
+        The comm term is the full alpha-beta price: bytes/bandwidth (beta)
+        plus collectives x alpha — the synchronization count the model
+        already tracks; pricing bytes only under-ranked latency-bound
+        small-N / high-q configs (each num_chunks slice adds an alpha,
+        not bytes)."""
         spec = spec or device_spec()
         peak = spec.peak_tflops(dtype) * 1e12 * efficiency
         out = {}
         for tag, s in self.stats.items():
-            out[tag] = (s.flops / peak, s.comm_bytes / (spec.ici_gbps * 1e9))
+            comm = s.comm_bytes / (spec.ici_gbps * 1e9) + s.collectives * spec.alpha_s
+            out[tag] = (s.flops / peak, comm)
         return out
 
 

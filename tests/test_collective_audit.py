@@ -5,6 +5,11 @@ collective inventory of WHOLE programs — a full cholinv factor and a
 dist-regime CQR2 — compiled for the 2x2x{1,2} grids, against (a) structural
 invariants of the schedule and (b) exact emitted-count snapshots.
 
+Since the obs layer landed, the inventory is taken through
+capital_tpu.obs.xla_audit (the library the ledger and the audit CLI use)
+rather than a private regex here — so these pins also exercise the
+production scan path.
+
 Why snapshots and not model equality: the Recorder prices the *schedule's*
 collectives (panel gathers / masked-psum broadcasts / depth collects /
 base-case replications — e.g. 43 for the c=2 factor below), while the
@@ -27,9 +32,6 @@ Invariants (version-robust):
     (masked-psum panel broadcasts + depth collects + base-case bcasts).
 """
 
-import re
-import collections
-
 import jax
 import jax.numpy as jnp
 import pytest
@@ -37,24 +39,25 @@ import pytest
 from capital_tpu.models import cholesky, qr
 from capital_tpu.models.cholesky import CholinvConfig
 from capital_tpu.models.qr import CacqrConfig
+from capital_tpu.obs import xla_audit
 from capital_tpu.parallel.topology import Grid
-from capital_tpu.utils import rand48, tracing
-
-KINDS = ("all-gather", "all-reduce", "collective-permute", "all-to-all")
+from capital_tpu.utils import rand48
 
 
 def _emitted(fn, arg) -> dict[str, int]:
-    txt = jax.jit(fn).lower(arg).compile().as_text()
-    return {k: len(re.findall(rf"= [^=]*{k}\(", txt)) for k in KINDS}
+    return xla_audit.audit(fn, arg).collective_counts
 
 
 def _model_collectives(fn, arg) -> int:
-    # fresh jit wrapper: the Recorder captures once per jit cache entry, and
-    # `fn` itself may already be traced (e.g. by _emitted) — a cache hit
-    # records nothing
-    with tracing.Recorder() as rec:
-        jax.jit(lambda a: fn(a)).lower(arg)
+    rec = xla_audit.trace_model(fn, arg)
     return sum(s.collectives for s in rec.stats.values())
+
+
+def _counts(ag=0, ar=0, rs=0, cp=0, aa=0) -> dict[str, int]:
+    return {
+        "all-gather": ag, "all-reduce": ar, "reduce-scatter": rs,
+        "collective-permute": cp, "all-to-all": aa,
+    }
 
 
 class TestCholinvAudit:
@@ -77,10 +80,25 @@ class TestCholinvAudit:
         # permutes are sharding-constraint/DUS motion.  Re-pin only after
         # re-deriving (see module docstring).
         assert _model_collectives(fn, A) == 31
-        assert got == {
-            "all-gather": 44, "all-reduce": 0,
-            "collective-permute": 55, "all-to-all": 0,
-        }, got
+        assert got == _counts(ag=44, cp=55), got
+
+    @pytest.mark.skipif(
+        not hasattr(jax, "shard_map"),
+        reason="multi-device explicit-mode compile needs jax.shard_map",
+    )
+    def test_c1_drift_totals(self, grid2x2x1):
+        # the drift report must carry the SAME totals the snapshots pin —
+        # model 31 vs compiled 99 — and every phase lands in one of the
+        # three classifications (drift() is the gate `make audit` runs)
+        g = grid2x2x1
+        A = jax.device_put(jnp.asarray(rand48.symmetric(64)), g.face_sharding())
+        cfg = CholinvConfig(base_case_dim=16, mode="explicit")
+        fn = lambda a: cholesky.factor(g, a, cfg)
+        rep = xla_audit.drift(xla_audit.audit(fn, A), xla_audit.trace_model(fn, A))
+        assert rep.model_collectives_total == 31
+        assert rep.compiled_collectives_total == 99
+        kinds = {p.classification for p in rep.phases}
+        assert kinds <= {xla_audit.WITHIN, xla_audit.UNDERCOUNT, xla_audit.EXTRA}
 
     def test_c2_factor_inventory(self, grid2x2x2):
         g = grid2x2x2
@@ -92,10 +110,7 @@ class TestCholinvAudit:
         assert got["all-reduce"] > 0  # masked-psum bcasts + depth collects
         # model: 43 = 4 factor_diag + 9 trsm + 12 tmu + 18 inv
         assert _model_collectives(fn, A) == 43
-        assert got == {
-            "all-gather": 20, "all-reduce": 32,
-            "collective-permute": 55, "all-to-all": 0,
-        }, got
+        assert got == _counts(ag=20, ar=32, cp=55), got
 
     def test_c2_skipping_does_not_change_collectives(self, grid2x2x2):
         # dead-segment skipping guards ONLY local matmuls; disabling the
@@ -137,7 +152,4 @@ class TestCacqrAudit:
         # 3 merge — the two full cholinv factors dominate, as upstream
         # (cacqr.hpp:103)
         assert _model_collectives(fn, A) == 103
-        assert got == {
-            "all-gather": 40, "all-reduce": 74,
-            "collective-permute": 114, "all-to-all": 0,
-        }, got
+        assert got == _counts(ag=40, ar=74, cp=114), got

@@ -116,6 +116,84 @@ def test_estimate_and_tables(tmp_path, grid2x2x1):
     assert len(c_lines) == 2 and "CI::trsm-comp" in c_lines[0]
 
 
+def test_note_counts_under_own_tag():
+    with tracing.Recorder() as rec:
+        tracing.note("layout_fallback")
+        tracing.note("layout_fallback")
+    assert rec.stats["layout_fallback"].calls == 2
+    assert rec.stats["layout_fallback"].flops == 0.0
+    tracing.note("layout_fallback")  # no active recorder: must be a no-op
+
+
+def test_tables_with_empty_rows(tmp_path):
+    # an all-UNRESOLVED sweep still writes its tables; header-only output,
+    # no max() crash on the empty column set
+    times = tmp_path / "t.txt"
+    costs = tmp_path / "c.txt"
+    tracing.write_times_table(str(times), [])
+    tracing.write_costs_table(str(costs), [])
+    assert times.read_text().splitlines() == ["Config  Raw     "]
+    assert costs.read_text().splitlines()[0].startswith("Config")
+
+
+def test_estimate_seconds_prices_alpha_latency():
+    # the comm term is beta (bytes/bandwidth) PLUS alpha per collective;
+    # same bytes at a higher synchronization count must cost more
+    spec = tracing.DeviceSpec("test", 100.0, 1000.0, 100.0, alpha_s=1e-6)
+    few, many = tracing.Recorder(), tracing.Recorder()
+    with few:
+        with tracing.scope("CI::trsm"):
+            tracing.emit(1e9, 1e6, collectives=1)
+    with many:
+        with tracing.scope("CI::trsm"):
+            tracing.emit(1e9, 1e6, collectives=100)
+    _, comm_few = few.estimate_seconds(spec, jnp.bfloat16)["CI::trsm"]
+    _, comm_many = many.estimate_seconds(spec, jnp.bfloat16)["CI::trsm"]
+    assert comm_many == pytest.approx(comm_few + 99 * spec.alpha_s)
+    beta = 1e6 / (spec.ici_gbps * 1e9)
+    assert comm_few == pytest.approx(beta + spec.alpha_s)
+
+
+def test_scope_rejects_unregistered_tag():
+    with pytest.raises(ValueError, match="unregistered phase tag"):
+        with tracing.scope("XX::nope"):
+            pass
+
+
+def test_register_phase_extends_live_registry():
+    tag = "XX::test_only"
+    assert tag not in tracing.PHASE_REGISTRY
+    try:
+        tracing.register_phase(tag)
+        assert tag in tracing.PHASE_REGISTRY
+        with tracing.Recorder() as rec:
+            with tracing.scope(tag):
+                tracing.emit(flops=1.0)
+        assert rec.stats[tag].flops == 1.0
+        # the trace tool's dot-form buckets see live registrations
+        from capital_tpu.bench import trace as trace_tool
+
+        assert "XX.test_only" in trace_tool._phase_tags()
+    finally:
+        # registry is module-global: restore to keep other tests order-free
+        tracing.PHASE_REGISTRY = tuple(
+            t for t in tracing.PHASE_REGISTRY if t != tag
+        )
+        tracing._PHASE_SET.discard(tag)
+
+
+def test_trace_tool_tags_derive_from_registry():
+    from capital_tpu.bench import trace as trace_tool
+
+    # _phase_tags() is the live derivation (PHASE_TAGS is a snapshot frozen
+    # at import, which another test's transient registration may predate)
+    assert set(trace_tool._phase_tags()) == {
+        t.replace("::", ".") for t in tracing.PHASE_REGISTRY
+    }
+    # the tag the old hardcoded list silently dropped to 'other'
+    assert "RT.batch_write" in trace_tool.PHASE_TAGS
+
+
 def test_measure_returns_sane_wall():
     f = jax.jit(lambda x: x @ x)
     x = jnp.ones((64, 64))

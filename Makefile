@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all test benchmarking tune audit native clean
+.PHONY: all test benchmarking tune audit robust native clean
 
 all: test
 
@@ -25,6 +25,13 @@ tune:
 audit:
 	$(PY) -m capital_tpu.obs audit cholinv --n 4096 --platform cpu
 	$(PY) -m capital_tpu.obs audit cacqr --m 16384 --n 512 --platform cpu
+	$(PY) -m capital_tpu.obs robust-gate --platform cpu
+
+# breakdown detection / shifted-CholeskyQR recovery / fault-injection suite
+# (docs/ROBUSTNESS.md); CPU rig — tests/conftest.py provides the 8-device
+# virtual mesh and enables x64
+robust:
+	$(PY) -m pytest tests/test_robust.py tests/test_faultinject.py -q
 
 native:
 	$(PY) -c "from capital_tpu import native; print('native engine available:', native.available())"

@@ -100,12 +100,47 @@ def _ckpt_path(out_dir: str, name: str, key: dict) -> str:
 
 
 def _ckpt_load(path: str, key: dict) -> dict:
+    """Load the resume state, tolerating entries written by older schemas.
+
+    A checkpoint is a cache, not a contract: an entry missing fields this
+    version reads (older writer, hand-edited file) is DROPPED with a note —
+    the config simply re-measures — instead of KeyError-aborting the whole
+    resume.  Kept entries:
+      * failure records ({"failed": true, ...}) — persisted so a config
+        that OOMed is not retried forever across resumes;
+      * measurement records with a numeric "seconds"; missing "config" /
+        "stats" default to {} (the table row degrades, the timing
+        survives)."""
     try:
         with open(path) as f:
             data = json.load(f)
     except (OSError, json.JSONDecodeError):
         return {}
-    return data.get("done", {}) if data.get("key") == key else {}
+    if data.get("key") != key:
+        return {}
+    done = data.get("done", {})
+    if not isinstance(done, dict):
+        return {}
+    out: dict = {}
+    for cid, entry in done.items():
+        if not isinstance(entry, dict):
+            print(f"# autotune resume: dropping malformed entry {cid!r}")
+            continue
+        if entry.get("failed"):
+            out[cid] = entry
+            continue
+        if isinstance(entry.get("seconds"), (int, float)):
+            out[cid] = {
+                "config": entry.get("config", {}),
+                "seconds": float(entry["seconds"]),
+                "stats": entry.get("stats", {}),
+            }
+        else:
+            print(
+                f"# autotune resume: dropping {cid!r} (no usable 'seconds' "
+                "— older schema?); it will be re-measured"
+            )
+    return out
 
 
 def _ckpt_save(path: str, key: dict, done: dict) -> None:
@@ -153,6 +188,7 @@ def run_sweep(
     checkpoint: bool = False,
     key_extra: dict | None = None,
     ledger: str | None = None,
+    retry: harness.RetryPolicy = harness.RetryPolicy(),
 ) -> list[SweepResult]:
     """Measure + model every (config_id, config_dict, step_fn) and write the
     cost tables.  Returns results sorted best-first by measured time.
@@ -164,10 +200,17 @@ def run_sweep(
     the condition can be a transient drift window, so every resume retries
     them.
 
+    Runtime failures (OOM / compile abort — XlaRuntimeError) of one config
+    are CONTAINED: retried per `retry` (harness.run_guarded), then recorded
+    as a failure — in the checkpoint (so resumes don't retry a known-bad
+    config forever) and as a status='failed' event in the ledger — while
+    the remaining configs keep sweeping.
+
     ledger=PATH additionally appends one obs ledger record per swept config
     (manifest keyed by config_id, the Recorder model decomposition, and the
     measured seconds) so sweeps land in the same queryable JSONL stream as
-    bench runs and audits."""
+    bench runs and audits.  Configs that needed retries land with a
+    status='recovered' event."""
     dtype = dtype or operand.dtype
     configs = list(configs)
     if not configs:
@@ -179,9 +222,17 @@ def run_sweep(
         os.makedirs(out_dir, exist_ok=True)
         done = _ckpt_load(ckpt_path, key)
     results: list[SweepResult] = []
+    attempts_by: dict[str, int] = {}
+    failures: list[tuple[str, dict, dict]] = []  # (cid, cdict, failure entry)
     for cid, cdict, step in configs:
         if cid in done:
             entry = done[cid]
+            if entry.get("failed"):
+                print(
+                    f"# autotune {name}: {cid}  FAILED previously "
+                    f"({entry.get('error', '?')}) — skipped on resume"
+                )
+                continue
             results.append(
                 SweepResult(
                     cid, entry["config"], entry["seconds"],
@@ -192,12 +243,32 @@ def run_sweep(
             continue
         rec = _model_costs(step, operand)
         try:
-            secs = harness.timed_loop(step, operand, iters=iters)
+            secs, attempts = harness.run_guarded(
+                lambda: harness.timed_loop(step, operand, iters=iters),
+                policy=retry,
+                label=f"{name}:{cid}",
+            )
         except harness.MeasurementUnresolved as e:
             # below the measurement noise floor: record nothing for this
             # config rather than aborting the sweep and losing the rest
             print(f"# autotune {name}: {cid}  UNRESOLVED ({e})")
             continue  # deliberately not checkpointed: retried on resume
+        except harness.ConfigFailed as e:
+            # runtime failure contained to this config: the sweep goes on
+            print(f"# autotune {name}: {cid}  FAILED ({e})")
+            entry = {
+                "failed": True,
+                "error": f"{type(e.cause).__name__}: {e.cause}",
+                "attempts": e.attempts,
+                "config": cdict,
+            }
+            failures.append((cid, cdict, entry))
+            if checkpoint:
+                done[cid] = entry
+                _ckpt_save(ckpt_path, key, done)
+            continue
+        if attempts > 1:
+            attempts_by[cid] = attempts
         results.append(SweepResult(cid, cdict, secs, rec))
         print(f"# autotune {name}: {cid}  {secs * 1e3:.3f} ms")
         if checkpoint:
@@ -219,10 +290,6 @@ def run_sweep(
         os.path.join(out_dir, f"{name}_cp_costs.txt"),
         [(r.config_id, r.recorder) for r in results],
     )
-    if not results:
-        raise RuntimeError(
-            f"autotune sweep {name!r}: no config produced a resolvable time"
-        )
     if ledger:
         from capital_tpu.obs import ledger as obs_ledger
 
@@ -230,18 +297,42 @@ def run_sweep(
         # key_extra's "grid" is already a repr string — it must not bind
         # manifest()'s grid parameter (which expects a Grid object)
         grid_repr = extra.pop("grid", None)
-        for r in results:
+
+        def _man(cdict, cid):
             man = obs_ledger.manifest(
-                dtype=dtype, config=r.config, config_id=r.config_id,
+                dtype=dtype, config=cdict, config_id=cid,
                 shape=list(operand.shape), **extra,
             )
             if grid_repr is not None:
                 man["grid"] = grid_repr
+            return man
+
+        # failure events FIRST: even a sweep where nothing resolved leaves
+        # its failures queryable (the raise below fires after this block)
+        for cid, cdict, entry in failures:
             obs_ledger.append(
                 ledger,
                 obs_ledger.record(
                     f"autotune:{name}",
-                    man,
+                    _man(cdict, cid),
+                    event={
+                        "status": "failed",
+                        "error": entry["error"],
+                        "attempts": entry["attempts"],
+                    },
+                ),
+            )
+        for r in results:
+            ev = (
+                {"status": "recovered", "attempts": attempts_by[r.config_id]}
+                if r.config_id in attempts_by
+                else None
+            )
+            obs_ledger.append(
+                ledger,
+                obs_ledger.record(
+                    f"autotune:{name}",
+                    _man(r.config, r.config_id),
                     model=obs_ledger.model_costs(r.recorder, dtype=dtype),
                     # value is rate (1/s), not seconds: diff() flags VALUE
                     # drops, and "slower" must read as a drop
@@ -251,8 +342,13 @@ def run_sweep(
                         "unit": "iter/s",
                         "seconds": r.seconds,
                     },
+                    **({"event": ev} if ev else {}),
                 ),
             )
+    if not results:
+        raise RuntimeError(
+            f"autotune sweep {name!r}: no config produced a resolvable time"
+        )
     results.sort(key=lambda r: r.seconds)
     best = results[0]
     with open(os.path.join(out_dir, f"{name}_best.json"), "w") as f:

@@ -33,6 +33,7 @@ from capital_tpu.bench import harness
 from capital_tpu.models import cholesky, inverse, qr
 from capital_tpu.parallel import summa
 from capital_tpu.parallel.topology import Grid
+from capital_tpu.robust.config import RobustConfig
 from capital_tpu.utils import residual
 
 
@@ -60,7 +61,7 @@ def _gate(name: str, value: float, tol: float) -> None:
 
 def _ledger_append(
     args, rec: dict, *, name: str, grid: Grid, cfg=None, step=None,
-    operand=None, dtype=None,
+    operand=None, dtype=None, extra_record: dict | None = None,
 ) -> None:
     """Append one unified ledger record for a finished driver run (opt-in
     via --ledger PATH; no-op otherwise).  `name` is the driver's own name —
@@ -103,6 +104,7 @@ def _ledger_append(
         measured=rec,
         residuals=residuals or None,
         **({"audit_error": err} if err else {}),
+        **(extra_record or {}),
     )
     ledger.append(path, row)
 
@@ -338,6 +340,7 @@ def cacqr(args) -> dict:
     dtype = jnp.dtype(args.dtype)
     mode = _resolve_mode(args.mode, grid)
     precision = _precision(args, dtype)
+    robust = getattr(args, "robust", False)
     cfg = qr.CacqrConfig(
         num_iter=args.variant,
         regime=args.regime,
@@ -347,6 +350,7 @@ def cacqr(args) -> dict:
         ),
         precision=precision,
         fused_g=getattr(args, "fused_g", 0),
+        robust=RobustConfig() if robust else None,
     )
     # One-shot regen protocol when the A-carry would not fit: the standard
     # loop keeps FOUR Q-sized buffers at peak (A carry, Q1, Q, and the
@@ -357,8 +361,11 @@ def cacqr(args) -> dict:
     # the element-coupling eligibility (qr.pallas_coupled) — the one-shot
     # consume is a one-element read.
     elem_ok = qr.pallas_coupled(grid, args.n, mode, m=args.m, dtype=dtype)
+    # --robust measures the guarded path (status scalars in the carry), which
+    # the scalar one-shot consume would dead-code-eliminate
     oneshot = (
         elem_ok
+        and not robust
         and grid.num_devices == 1
         and 4.1 * args.m * args.n * dtype.itemsize > _hbm_bytes()
     )
@@ -388,12 +395,23 @@ def cacqr(args) -> dict:
         )
 
         def step(a):
-            Q, R = qr.factor(grid, a, cfg)
+            res = qr.factor(grid, a, cfg)
+            Q, R = res[0], res[1]
             # fold R into the tall carry via a slice-add so the carry keeps
             # A's shape while both outputs stay live (the carry is
             # Q-shaped, so the loop factors its own running output — same
             # discipline as bench.py's cholinv loop)
-            return Q.at[: R.shape[0], : R.shape[1]].add(R.astype(Q.dtype))
+            out = Q.at[: R.shape[0], : R.shape[1]].add(R.astype(Q.dtype))
+            if cfg.robust is not None:
+                # keep the guard live in the measured program: the shift is
+                # data-dependent and exactly 0 on a healthy factorization
+                ri = res[2]
+                out = out.at[0, 0].add(
+                    (ri.sigma * ri.breakdown.astype(ri.sigma.dtype)).astype(
+                        out.dtype
+                    )
+                )
+            return out
 
         # element carry only when the factor's outputs ride un-narrowable
         # ops (saves a Q-sized full-add, ~5 ms/iter at 1M x 1024); the
@@ -403,17 +421,31 @@ def cacqr(args) -> dict:
         audit_operand = A
     # useful flops per sweep: gram mn² + Q·R⁻¹ mn²; CQR2 doubles the sweeps
     flops = 2.0 * args.m * args.n**2 * cfg.num_iter
+    robust_d = None
+    if cfg.robust is not None:
+        # one extra factorization of the bench operand to read the status
+        # scalars out (the timed loop only keeps them live, not inspectable)
+        ri = jax.jit(lambda a: qr.factor(grid, a, cfg)[2])(A)
+        robust_d = {
+            "info": int(ri.info),
+            "breakdown": int(ri.breakdown),
+            "shifted": int(ri.shifted),
+            "sigma": float(ri.sigma),
+            "escalated": int(ri.escalated),
+            "ortho": float(ri.ortho),
+        }
     rec = harness.report(
         "cacqr_tflops", t, flops, dtype, m=args.m, n=args.n,
         variant=args.variant, grid=repr(grid), mode=mode, **applied_knobs,
-        **extra,
+        **extra, **({"robust": robust_d} if robust_d else {}),
     )
     if args.validate:
         if A is None:  # one-shot runs: validate one regenerated instance
             A = jax.block_until_ready(
                 jax.jit(lambda: _tall_hash(args.m, args.n, dtype, 0))()
             )
-        Q, R = jax.jit(lambda a: qr.factor(grid, a, cfg))(A)
+        res = jax.jit(lambda a: qr.factor(grid, a, cfg))(A)
+        Q, R = res[0], res[1]
         tol = _tolerance(dtype)
         _gate("qr_orthogonality", float(residual.qr_orthogonality(Q)), tol)
         # row-blocked accumulation: the dense residual's m x n f32
@@ -423,9 +455,15 @@ def cacqr(args) -> dict:
             float(jax.jit(residual.qr_residual_blocked)(A, Q, R)),
             tol,
         )
+    extra_record = None
+    if robust_d is not None:
+        extra_record = {"robust": robust_d}
+        if robust_d["breakdown"]:
+            status = "recovered" if robust_d["info"] == 0 else "breakdown"
+            extra_record["event"] = {"status": status}
     _ledger_append(
         args, rec, name="cacqr", grid=grid, cfg=cfg, step=step,
-        operand=audit_operand, dtype=dtype,
+        operand=audit_operand, dtype=dtype, extra_record=extra_record,
     )
     return rec
 
@@ -779,6 +817,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="rectri batched-level-sweep threshold (-1 = config default)",
     )
     p.add_argument("--no-complete-inv", action="store_true")
+    p.add_argument(
+        "--robust", action="store_true",
+        help="cacqr: factor under RobustConfig (breakdown detection + "
+        "shifted-CholeskyQR recovery, docs/ROBUSTNESS.md); the status "
+        "scalars ride the report and the ledger record",
+    )
     p.add_argument("--validate", action="store_true")
     p.add_argument(
         "--ledger", default=None,

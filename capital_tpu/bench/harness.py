@@ -23,7 +23,9 @@ peak-relative north star *is* the baseline).
 
 from __future__ import annotations
 
+import dataclasses
 import json
+import sys
 import time
 from typing import Callable
 
@@ -31,6 +33,19 @@ import jax
 import jax.numpy as jnp
 
 from capital_tpu.utils import tracing
+
+# The device-trace floor machinery (bench/trace.device_budget) can fail for
+# exactly these reasons: the xplane protobuf import is unavailable
+# (ImportError), the profiler emitted no xplane.pb / an unreadable one
+# (RuntimeError / OSError), or a malformed plane parses to nonsense
+# (ValueError).  Anything else — XlaRuntimeError from the measured program,
+# KeyboardInterrupt, bugs — must PROPAGATE: the old bare `except Exception`
+# here swallowed real failures into a silent "no floor".
+TRACE_FLOOR_ERRORS = (ImportError, OSError, RuntimeError, ValueError)
+
+
+def _warn(msg: str) -> None:
+    print(f"# harness: {msg}", file=sys.stderr)
 
 
 def peak_tflops(device=None, dtype=jnp.bfloat16) -> float:
@@ -44,6 +59,73 @@ class MeasurementUnresolved(RuntimeError):
     Distinct from generic RuntimeError so sweep drivers can skip noise-floor
     configs without also swallowing real failures (XlaRuntimeError — OOM,
     compile errors — subclasses RuntimeError)."""
+
+
+# The runtime failure class the containment layer bounds: OOMs, compile
+# errors, device aborts.  jax.errors.JaxRuntimeError IS the XlaRuntimeError
+# alias in current jax; the tuple exists so a jaxlib rename stays a one-line
+# fix here instead of a hunt through every sweep driver.
+RUNTIME_FAILURES = (jax.errors.JaxRuntimeError,)
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded retry/backoff for per-config runtime failures in a sweep.
+
+    retries: attempts AFTER the first (0 = fail immediately).  Default 1:
+        transient device OOMs (fragmentation after a big predecessor
+        config) often clear on a retry; deterministic failures shouldn't
+        burn more than one.
+    backoff_s / multiplier: sleep before attempt k is
+        backoff_s * multiplier**(k-1) — gives the runtime a beat to release
+        buffers before the retry."""
+
+    retries: int = 1
+    backoff_s: float = 0.25
+    multiplier: float = 2.0
+
+
+class ConfigFailed(RuntimeError):
+    """One sweep config exhausted its RetryPolicy on runtime failures.
+    Carries the attempt count and the final cause so the sweep can persist
+    a useful failure record instead of a bare traceback."""
+
+    def __init__(self, label: str, attempts: int, cause: BaseException):
+        super().__init__(
+            f"{label} failed after {attempts} attempt(s): "
+            f"{type(cause).__name__}: {cause}"
+        )
+        self.label = label
+        self.attempts = attempts
+        self.cause = cause
+
+
+def run_guarded(
+    fn: Callable[[], object],
+    policy: RetryPolicy = RetryPolicy(),
+    label: str = "config",
+) -> tuple[object, int]:
+    """Run fn() with the bounded retry/backoff of `policy`; returns
+    (result, attempts).  Catches ONLY RUNTIME_FAILURES — an OOM/compile
+    abort of this config must not kill the whole sweep — and re-raises as
+    ConfigFailed once the policy is exhausted.  MeasurementUnresolved and
+    every other exception propagate untouched (they already have their own
+    handling story in the callers)."""
+    delay = policy.backoff_s
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            return fn(), attempt
+        except RUNTIME_FAILURES as e:
+            if attempt > policy.retries:
+                raise ConfigFailed(label, attempt, e) from e
+            _warn(
+                f"{label} attempt {attempt} failed "
+                f"({type(e).__name__}); retrying in {delay:.2f}s"
+            )
+            time.sleep(delay)
+            delay *= policy.multiplier
 
 
 def noise_band_seconds() -> float:
@@ -143,8 +225,14 @@ def device_ms_per_iter(
 
     try:
         return max(0.0, (total(iters + 1) - total(1)) / iters)
-    except Exception:
-        return 0.0  # tracing unavailable: no floor, wall stands
+    except TRACE_FLOOR_ERRORS as e:
+        if isinstance(e, jax.errors.JaxRuntimeError):
+            raise  # a device-side failure of the measured program itself
+        _warn(
+            f"device trace unavailable ({type(e).__name__}: {e}); "
+            "no device floor, wall stands"
+        )
+        return 0.0
 
 
 def timed_loop(
@@ -292,7 +380,13 @@ def timed_oneshot(
             dfull = dev_total(full, iters + 1) - dev_total(full, 1)
             dregen = dev_total(regen, iters + 1) - dev_total(regen, 1)
             dnet = max(0.0, (dfull - dregen) / iters)
-        except Exception:
+        except TRACE_FLOOR_ERRORS as e:
+            if isinstance(e, jax.errors.JaxRuntimeError):
+                raise  # device-side failure of the measured program
+            _warn(
+                f"one-shot device floor unavailable ({type(e).__name__}: "
+                f"{e}); wall stands unfloored"
+            )
             dnet = 0.0
         if dnet > 0.0:
             tries = 0

@@ -50,6 +50,8 @@ from jax.sharding import PartitionSpec as P
 
 from capital_tpu.ops import lapack, pallas_tpu
 from capital_tpu.parallel import summa
+from capital_tpu.robust import detect
+from capital_tpu.robust.config import RobustConfig
 from capital_tpu.parallel.summa import SyrkArgs, TrmmArgs
 from capital_tpu.parallel.topology import Grid
 from capital_tpu.utils import tracing
@@ -108,6 +110,13 @@ class CholinvConfig:
     # standard bench loop carrying A across iterations, or a validation
     # reading A afterwards), XLA inserts a full-buffer copy that costs the
     # memory back plus an HBM pass, which is why this is opt-in.
+    robust: Optional[RobustConfig] = None  # breakdown DETECTION: factor()
+    # returns (R, Rinv, info) with a LAPACK-style int32 status of R
+    # (robust/detect.factor_info) instead of NaN-filling silently on a
+    # non-SPD input.  Detection only — no shifted rescue here: shifting a
+    # user's gram inside cholinv would change the problem being solved;
+    # the shifted-CholeskyQR recovery lives in models/qr.factor where the
+    # shift is an internal implementation detail of the sweep.
 
 
 # --------------------------------------------------------------------------
@@ -478,7 +487,11 @@ def factor(
     initialization the next one needs — without this, XLA hoists the
     loop-invariant zero-init out of a benchmark loop and re-COPIES the
     buffers every iteration before the first aliased write (measured 2 x
-    3.27 ms/iter at n=49152)."""
+    3.27 ms/iter at n=49152).
+
+    With cfg.robust set the return is (R, Rinv, info): info is the int32
+    breakdown status of the (cropped) factor — 0 clean, else the LAPACK
+    potrf convention (robust/detect.factor_info)."""
     n = A.shape[0]
     if A.shape[0] != A.shape[1]:
         raise ValueError(f"cholinv needs a square matrix, got {A.shape}")
@@ -508,7 +521,11 @@ def factor(
             )
         _, R, Rinv = _recurse(grid, Ap, 0, node, cfg, True, Rp, RIp)
         R, Rinv = grid.pin(R), grid.pin(Rinv)
-        return (R[:n, :n], Rinv[:n, :n]) if p != n else (R, Rinv)
+        if p != n:
+            R, Rinv = R[:n, :n], Rinv[:n, :n]
+        if cfg.robust is not None:
+            return R, Rinv, detect.factor_info(R)
+        return R, Rinv
 
     tile = _zeros_plan(grid, node, cfg)
     if tile:
@@ -536,6 +553,8 @@ def factor(
     R, Rinv = grid.pin(R), grid.pin(Rinv)
     if p != n:
         R, Rinv = R[:n, :n], Rinv[:n, :n]
+    if cfg.robust is not None:
+        return R, Rinv, detect.factor_info(R)
     return R, Rinv
 
 
@@ -567,7 +586,7 @@ def spd_inverse(
 ) -> jnp.ndarray:
     """A⁻¹ = R⁻¹·R⁻ᵀ for SPD A — the 'SPD inverse via Cholesky' capability
     (BASELINE.md config row 5)."""
-    cfg = dataclasses.replace(cfg, complete_inv=True)
+    cfg = dataclasses.replace(cfg, complete_inv=True, robust=None)
     _, Rinv = factor(grid, A, cfg)
     return summa.gemm(
         grid, Rinv, Rinv,

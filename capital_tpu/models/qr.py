@@ -55,6 +55,8 @@ from capital_tpu.ops import lapack, pallas_tpu
 from capital_tpu.parallel import summa
 from capital_tpu.parallel.summa import GemmArgs, SyrkArgs, TrmmArgs
 from capital_tpu.parallel.topology import Grid
+from capital_tpu.robust import faultinject, recovery
+from capital_tpu.robust.config import RobustConfig, RobustInfo
 from capital_tpu.utils import tracing
 
 
@@ -85,6 +87,44 @@ class CacqrConfig:
     # kernels: executed flops are (g+1)/2g of dense at zero extra HBM
     # traffic (all sub-products VMEM-resident).  0 = auto
     # (qr_fused.pick_g: largest eligible in {8,4,2})
+    robust: RobustConfig | None = None  # breakdown detection + shifted-
+    # CholeskyQR recovery (docs/ROBUSTNESS.md): factor() returns
+    # (Q, R, RobustInfo) instead of (Q, R), every Cholesky site is guarded,
+    # and a detected breakdown re-factors the shifted gram + escalates to a
+    # third sweep (sCQR3) when the orthogonality gate still fails.  On a
+    # multi-device grid the guarded sweeps run unfused (traced status
+    # values cannot escape the fused pipeline's shard_map body).
+
+
+# --------------------------------------------------------------------------
+# robust session: collects per-site CholEvents while factor() traces
+# --------------------------------------------------------------------------
+
+
+class _Session:
+    """One robust factor() invocation: the active RobustConfig plus the
+    CholEvents its guarded sites record (trace-order, so the aggregate in
+    _finish_robust is deterministic)."""
+
+    def __init__(self, rcfg: RobustConfig):
+        self.rcfg = rcfg
+        self.events: list = []
+
+
+_ROBUST: list[_Session] = []
+
+
+def _chol_site(G: jnp.ndarray, m_rows: int, chol_fn):
+    """Factor a gram at one Cholesky site.  Outside a robust session this
+    is chol_fn(G) verbatim — zero overhead on the default path.  Inside
+    one, the site is wrapped in recovery.guarded_chol (detection + shifted
+    retry) and its CholEvent lands on the session."""
+    if not _ROBUST:
+        return chol_fn(G)
+    ses = _ROBUST[-1]
+    R, Rinv, ev = recovery.guarded_chol(G, m_rows, ses.rcfg, chol_fn)
+    ses.events.append(ev)
+    return R, Rinv
 
 
 # --------------------------------------------------------------------------
@@ -187,9 +227,10 @@ def _sweep_1d(
         else:
             G = jnp.matmul(A.T, A, precision=precision)
         G = lax.with_sharding_constraint(G, grid.replicated_sharding())
+        G = faultinject.tap(G)
     with tracing.scope("CQR::chol"):
         tracing.emit(flops=tracing.potrf_trtri_flops(n))
-        R, Rinv = lapack.potrf_trtri(G, uplo="U")
+        R, Rinv = _chol_site(G, m, lambda g_: lapack.potrf_trtri(g_, uplo="U"))
     with tracing.scope("CQR::formR"):
         # the live-tile kernel is an explicit mode choice (the bench driver's
         # 'auto' resolves to pallas on one TPU); other modes take the dense
@@ -228,7 +269,7 @@ def _sweep_1d(
     return Q, R
 
 
-def _gram_chol(grid: Grid, G: jnp.ndarray, cfg: CacqrConfig):
+def _gram_chol(grid: Grid, G: jnp.ndarray, cfg: CacqrConfig, m_rows: int):
     """(R, R⁻¹) of the UPPER-VALID gram, shared by every fused/panel tier.
 
     Wide grams route through the recursive cholinv: the whole-matrix lax
@@ -244,15 +285,15 @@ def _gram_chol(grid: Grid, G: jnp.ndarray, cfg: CacqrConfig):
     regime's blocked solve, solve_blocked)."""
     n = G.shape[0]
     if n >= 2048 and grid.num_devices == 1:
-        return cholesky.factor(
-            grid,
-            G,
-            dataclasses.replace(
-                cfg.cholinv, mode=cfg.mode, precision=cfg.precision,
-                complete_inv=True,
-            ),
+        # robust=None on the NESTED config: the session's guarded_chol
+        # owns detection here — a 3-tuple from cholinv would break the
+        # (R, Rinv) contract every tier builds on
+        ccfg = dataclasses.replace(
+            cfg.cholinv, mode=cfg.mode, precision=cfg.precision,
+            complete_inv=True, robust=None,
         )
-    return lapack.potrf_trtri_upper(G)
+        return _chol_site(G, m_rows, lambda g_: cholesky.factor(grid, g_, ccfg))
+    return _chol_site(G, m_rows, lapack.potrf_trtri_upper)
 
 
 def _cqr2_fused(
@@ -276,13 +317,13 @@ def _cqr2_fused(
     live = qr_fused.live_fraction(g)
 
     def _chol(G):
-        return _gram_chol(grid, G, cfg)
+        return _gram_chol(grid, G, cfg, m)
 
     def _gram_out(Gu):
         # both chol routes read only the valid upper triangle — the
         # symmetric assembly pass (n² of block transposes + re-layout,
         # ~3 ms/iter inside the gram scopes at n=4096) is never needed
-        return Gu.astype(A.dtype)
+        return faultinject.tap(Gu.astype(A.dtype))
 
     with tracing.scope("CQR::gram"):
         tracing.emit(flops=2.0 * m * n * n * live)
@@ -344,7 +385,7 @@ def _cqr2_panels(
     live = (g + 1) / (2.0 * g)
 
     def _chol(G):
-        return _gram_chol(grid, G, cfg)
+        return _gram_chol(grid, G, cfg, m)
 
     def gram(X):
         cols = []
@@ -354,7 +395,7 @@ def _cqr2_panels(
                 precision=precision,
             )
             cols.append(jnp.pad(P, ((0, n - (j + 1) * c), (0, 0))))
-        return jnp.concatenate(cols, axis=1).astype(A.dtype)
+        return faultinject.tap(jnp.concatenate(cols, axis=1).astype(A.dtype))
 
     def scale(X, Rinv):
         Rt = jnp.triu(Rinv)
@@ -497,8 +538,12 @@ def _sweep_dist(
         G = summa.syrk(
             grid, A, args=SyrkArgs(trans=True, precision=cfg.precision), mode=cfg.mode
         )
+        G = faultinject.tap(G)
     with tracing.scope("CQR::chol"):
-        R, Rinv = cholesky.factor(grid, G, cfg.cholinv)
+        ccfg = dataclasses.replace(cfg.cholinv, robust=None)
+        R, Rinv = _chol_site(
+            G, A.shape[0], lambda g_: cholesky.factor(grid, g_, ccfg)
+        )
     with tracing.scope("CQR::formR"):
         if cfg.cholinv.complete_inv:
             Q = summa.trmm(
@@ -605,6 +650,13 @@ def pallas_coupled(
 
 
 def _pick_regime(grid: Grid, n: int, cfg: CacqrConfig) -> str:
+    # validate up front: an unknown string used to fall through to the dist
+    # path silently, turning a typo ('1D', 'fused', ...) into a whole
+    # different algorithm with no signal
+    if cfg.regime not in ("1d", "dist", "auto"):
+        raise ValueError(
+            f"unknown regime {cfg.regime!r}; expected '1d', 'dist' or 'auto'"
+        )
     if cfg.regime != "auto":
         return cfg.regime
     if grid.dy == 1 and grid.c == 1:
@@ -612,22 +664,12 @@ def _pick_regime(grid: Grid, n: int, cfg: CacqrConfig) -> str:
     return "1d" if n <= cfg.dist_threshold else "dist"
 
 
-@pallas_tpu.scoped_by_grid
-def factor(
-    grid: Grid, A: jnp.ndarray, cfg: CacqrConfig = CacqrConfig()
+def _factor_core(
+    grid: Grid, A: jnp.ndarray, cfg: CacqrConfig, regime: str
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """QR of tall-skinny A: returns (Q, R) with A = QR, R upper triangular.
-
-    Equivalent of qr::cacqr::factor (cacqr.hpp:216-245); jit-friendly.
-    num_iter=2 (CQR2) merges the two sweeps' triangular factors with a
-    trmm, R = R2·R1 (cacqr.hpp:181-189, 204-210).
-    """
+    """The regime dispatch + sweep pipeline shared by the plain and robust
+    entries (factor)."""
     m, n = A.shape
-    if m < n:
-        raise ValueError(f"cacqr expects tall-skinny input, got {A.shape}")
-    if cfg.num_iter not in (1, 2):
-        raise ValueError(f"num_iter must be 1 (CQR) or 2 (CQR2), got {cfg.num_iter}")
-    regime = _pick_regime(grid, n, cfg)
     if regime == "1d":
         from capital_tpu.ops import qr_fused
 
@@ -643,9 +685,12 @@ def factor(
             if grid.num_devices == 1:
                 return _cqr2_panels(grid, A, cfg)
         elif plan:
-            if grid.num_devices > 1:
+            if grid.num_devices == 1:
+                return _cqr2_fused(grid, A, cfg, g, plan)
+            if not _ROBUST:
                 return _cqr2_fused_sharded(grid, A, cfg, g, plan)
-            return _cqr2_fused(grid, A, cfg, g, plan)
+            # robust multi-device: the session's traced event values cannot
+            # escape the shard_map body — the guarded sweeps run unfused
         Q, R = _sweep_1d(grid, A, cfg)
         if cfg.num_iter == 2:
             Q, R2 = _sweep_1d(grid, Q, cfg)
@@ -664,6 +709,145 @@ def factor(
                 TrmmArgs(side="L", uplo="U", precision=cfg.precision), mode=cfg.mode,
             )
     return Q, R
+
+
+def _finish_robust(grid: Grid, Q, R, cfg: CacqrConfig, ses: _Session):
+    """Aggregate the session's CholEvents into a RobustInfo and, on
+    breakdown, run the sCQR3 escalation: one more (muted) gram + guarded
+    chol + scale, entered only when the orthogonality gate of the recovered
+    Q still exceeds tolerance.  Everything is lax.cond-gated, so the
+    healthy path executes only the O(n²) status reductions."""
+    rcfg = ses.rcfg
+    m, n = Q.shape[0], R.shape[0]
+    if ses.events:
+        infos = jnp.stack([jnp.asarray(ev.info, jnp.int32) for ev in ses.events])
+        sigmas = jnp.stack(
+            [jnp.asarray(ev.sigma, jnp.float32) for ev in ses.events]
+        )
+        infos_after = jnp.stack(
+            [jnp.asarray(ev.info_after, jnp.int32) for ev in ses.events]
+        )
+        breakdown = jnp.sum((infos != 0).astype(jnp.int32))
+        shifted = jnp.sum((sigmas > 0).astype(jnp.int32))
+        sigma = jnp.max(sigmas)
+        info = jnp.max(infos_after)
+    else:
+        breakdown = jnp.int32(0)
+        shifted = jnp.int32(0)
+        sigma = jnp.float32(0.0)
+        info = jnp.int32(0)
+    escalated = jnp.int32(0)
+    ortho = jnp.float32(-1.0)
+    if rcfg.escalate and ses.events:
+        tol = rcfg.ortho_tol
+        if tol is None:
+            tol = 100.0 * n * recovery.unit_roundoff(Q.dtype)
+
+        def _broke(args):
+            Q0, R0 = args
+            # CQR::recover scope: named HLO attribution for the audit layer;
+            # muted so the cost model keeps describing the healthy path
+            # (both cond branches trace — an emit here would double-count)
+            with tracing.scope("CQR::recover"), tracing.muted():
+                G3 = lax.with_sharding_constraint(
+                    jnp.matmul(Q0.T, Q0, precision=cfg.precision),
+                    grid.replicated_sharding(),
+                )
+                gate = (
+                    jnp.linalg.norm(G3 - jnp.eye(n, dtype=G3.dtype))
+                    / jnp.sqrt(jnp.asarray(n, G3.dtype))
+                ).astype(jnp.float32)
+
+                def _polish(args2):
+                    Q1, R1 = args2
+                    R3, R3inv, ev3 = recovery.guarded_chol(
+                        G3, m, rcfg,
+                        lambda g_: lapack.potrf_trtri(g_, uplo="U"),
+                    )
+                    Qp = lax.with_sharding_constraint(
+                        jnp.matmul(
+                            Q1, jnp.triu(R3inv), precision=cfg.precision
+                        ),
+                        grid.rows_sharding(),
+                    )
+                    Rp = jnp.matmul(
+                        jnp.triu(R3), jnp.triu(R1), precision=cfg.precision
+                    )
+                    # re-measure AFTER the third sweep: ortho must report
+                    # the returned Q, not the one the escalation replaced
+                    G4 = lax.with_sharding_constraint(
+                        jnp.matmul(Qp.T, Qp, precision=cfg.precision),
+                        grid.replicated_sharding(),
+                    )
+                    gate2 = (
+                        jnp.linalg.norm(G4 - jnp.eye(n, dtype=G4.dtype))
+                        / jnp.sqrt(jnp.asarray(n, G4.dtype))
+                    ).astype(jnp.float32)
+                    return Qp, Rp, jnp.int32(1), ev3.info_after, gate2
+
+                def _skip(args2):
+                    Q1, R1 = args2
+                    return Q1, R1, jnp.int32(0), jnp.int32(0), gate
+
+                Qn, Rn, esc, info3, gate_f = lax.cond(
+                    gate > tol, _polish, _skip, (Q0, R0)
+                )
+            return Qn, Rn, esc, gate_f, info3
+
+        def _fine(args):
+            Q0, R0 = args
+            return Q0, R0, jnp.int32(0), jnp.float32(-1.0), jnp.int32(0)
+
+        Q, R, escalated, ortho, info3 = lax.cond(
+            breakdown > 0, _broke, _fine, (Q, R)
+        )
+        # the sentinel n+2: every chol after recovery was clean, yet the
+        # final orthogonality gate still fails — cond(A) is beyond what
+        # sCQR3 can repair at this precision (per shifted sweep cond drops
+        # only by ~sqrt(shift_c*u*(m*n+n(n+1))); in f32 that's a factor of
+        # a few — see docs/ROBUSTNESS.md).  The result is finite but NOT
+        # orthogonal to tolerance, and info says so.
+        unrecovered = (escalated > 0) & (ortho > tol)
+        info = jnp.maximum(
+            jnp.maximum(info, info3),
+            jnp.where(unrecovered, jnp.int32(n + 2), jnp.int32(0)),
+        )
+    return Q, R, RobustInfo(
+        info=info, breakdown=breakdown, shifted=shifted, sigma=sigma,
+        escalated=escalated, ortho=ortho,
+    )
+
+
+@pallas_tpu.scoped_by_grid
+def factor(grid: Grid, A: jnp.ndarray, cfg: CacqrConfig = CacqrConfig()):
+    """QR of tall-skinny A: returns (Q, R) with A = QR, R upper triangular.
+
+    Equivalent of qr::cacqr::factor (cacqr.hpp:216-245); jit-friendly.
+    num_iter=2 (CQR2) merges the two sweeps' triangular factors with a
+    trmm, R = R2·R1 (cacqr.hpp:181-189, 204-210).
+
+    With cfg.robust set the return is (Q, R, RobustInfo): every Cholesky
+    site is breakdown-guarded, broken grams re-factor with the sCQR shift,
+    and the sCQR3 third sweep runs when the recovered Q's orthogonality
+    gate still exceeds tolerance (docs/ROBUSTNESS.md).  RobustInfo.info is
+    the residual status AFTER recovery — nonzero means the result is still
+    bad (e.g. a non-finite input) and must not be trusted.
+    """
+    m, n = A.shape
+    if m < n:
+        raise ValueError(f"cacqr expects tall-skinny input, got {A.shape}")
+    if cfg.num_iter not in (1, 2):
+        raise ValueError(f"num_iter must be 1 (CQR) or 2 (CQR2), got {cfg.num_iter}")
+    regime = _pick_regime(grid, n, cfg)
+    if cfg.robust is None:
+        return _factor_core(grid, A, cfg, regime)
+    ses = _Session(cfg.robust)
+    _ROBUST.append(ses)
+    try:
+        Q, R = _factor_core(grid, A, cfg, regime)
+    finally:
+        _ROBUST.pop()
+    return _finish_robust(grid, Q, R, cfg, ses)
 
 
 def apply_Q(

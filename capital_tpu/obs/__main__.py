@@ -10,6 +10,10 @@ executed or timed).
 metric, collective count, or peak-HBM regression beyond tolerance appears;
 exit 2 means the ledgers are not comparable (schema/device mismatch).
 
+``robust-gate`` is the CI self-check for the robustness exemption: a
+breakdown-recovery/failure record must pass diff un-flagged while the same
+value drop WITHOUT the status still flags (docs/ROBUSTNESS.md).
+
 Examples::
 
     python -m capital_tpu.obs audit cholinv --n 4096
@@ -138,6 +142,45 @@ def _audit(args) -> int:
     return 0
 
 
+def _robust_gate(args) -> int:
+    """CI gate: a breakdown-recovery record must round-trip through
+    ledger.diff WITHOUT being misread as a metric regression — and the
+    exemption must be doing the work (the same records stripped of their
+    robust/event blocks MUST flag).  Pure in-memory check, no device."""
+    from capital_tpu.obs import ledger
+
+    man = ledger.manifest(dtype="float32", config_id="robust_gate_probe")
+    base = ledger.record(
+        "bench:cacqr", dict(man),
+        measured={"metric": "cacqr", "value": 100.0, "unit": "TFLOP/s"},
+    )
+    # a recovery run: slower by far more than any tol_metric, carrying both
+    # signal shapes (the sweep's event block and the bench robust block)
+    recov = ledger.record(
+        "bench:cacqr", dict(man),
+        measured={"metric": "cacqr", "value": 40.0, "unit": "TFLOP/s"},
+        robust={"breakdown": 1, "shifted": 1, "escalated": 1, "info": 0},
+        event={"status": "recovered"},
+    )
+    regs = ledger.diff([base], [recov])
+    if regs:
+        print("# robust-gate: recovery record misread as regression:",
+              file=sys.stderr)
+        for r in regs:
+            print(r.line(), file=sys.stderr)
+        return 1
+    stripped = dict(recov)
+    stripped.pop("robust", None)
+    stripped.pop("event", None)
+    if not ledger.diff([base], [stripped]):
+        print("# robust-gate: value check is dead — a 60% drop without a "
+              "recovery status did not flag", file=sys.stderr)
+        return 1
+    print("# robust-gate OK: recovery events exempt from the metric check, "
+          "plain drops still flag")
+    return 0
+
+
 def _diff(args) -> int:
     from capital_tpu.obs import ledger
 
@@ -204,6 +247,15 @@ def build_parser() -> argparse.ArgumentParser:
     d.add_argument("--tol-hbm", type=float, default=0.05)
     d.add_argument("--tol-collective", type=int, default=0)
     d.set_defaults(fn=_diff)
+
+    g = sub.add_parser(
+        "robust-gate",
+        help="verify recovery/failure events round-trip through diff "
+             "without reading as metric regressions",
+    )
+    g.add_argument("--platform", default=None)
+    g.add_argument("--host-devices", type=int, default=0)
+    g.set_defaults(fn=_robust_gate)
     return p
 
 

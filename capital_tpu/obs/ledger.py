@@ -225,6 +225,28 @@ def _check_comparable(a: dict, b: dict) -> None:
         )
 
 
+def _event_status(rec: dict) -> Optional[str]:
+    """The robustness status of a record, when it carries one.
+
+    'failed' / 'recovered' come from an explicit event block (sweep failure
+    containment, autotune/sweep.py); 'recovery' is derived from a robust
+    block with nonzero breakdown/shift/escalation counters (a bench run
+    that went through the shifted-CholeskyQR path).  Records with a status
+    are exempt from the measured-value comparison in diff(): a run that
+    paid recovery sweeps (or failed outright) is slower BY DESIGN, and
+    reading that as a throughput regression would teach people to strip
+    the robust path before benchmarking."""
+    ev = rec.get("event")
+    if isinstance(ev, dict) and ev.get("status"):
+        return str(ev["status"])
+    rb = rec.get("robust")
+    if isinstance(rb, dict) and any(
+        rb.get(k) for k in ("breakdown", "shifted", "escalated")
+    ):
+        return "recovery"
+    return None
+
+
 def diff(
     a_recs: Iterable[dict],
     b_recs: Iterable[dict],
@@ -241,7 +263,10 @@ def diff(
     Only keys present in BOTH ledgers are compared (a missing row is a
     coverage change, not a regression); multiple records per key compare
     last-against-last (the ledger is append-ordered, so the last record is
-    the freshest trial)."""
+    the freshest trial).  Records carrying a failure/recovery status
+    (_event_status) skip ONLY the measured-value check — their walls
+    include recovery work or are absent entirely; the structural checks
+    (collectives, peak HBM) still apply."""
     a_by = {_key(r): r for r in a_recs}
     b_by = {_key(r): r for r in b_recs}
     out: list[Regression] = []
@@ -250,7 +275,8 @@ def diff(
         _check_comparable(a, b)
         am, bm = a.get("measured") or {}, b.get("measured") or {}
         av, bv = am.get("value"), bm.get("value")
-        if av and bv and bv < av * (1.0 - tol_metric):
+        exempt = _event_status(a) or _event_status(b)
+        if not exempt and av and bv and bv < av * (1.0 - tol_metric):
             out.append(
                 Regression(
                     key, "measured.value", av, bv,

@@ -23,6 +23,11 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax import lax
 
+# robust.{detect,faultinject} depend only on jax + tracing, so this import
+# cannot cycle back here.  The taps are identity when no fault plan is
+# active; with_info=False keeps every wrapper's signature unchanged.
+from capital_tpu.robust import detect, faultinject
+
 
 def _compute_dtype(dtype):
     """Panel factorizations run at >= f32: sub-f32 inputs (bf16/f16) are the
@@ -34,13 +39,19 @@ def _compute_dtype(dtype):
     return jnp.float32 if jnp.dtype(dtype).itemsize < 4 else jnp.dtype(dtype)
 
 
-def potrf(A: jnp.ndarray, uplo: str = "U") -> jnp.ndarray:
+def potrf(A: jnp.ndarray, uplo: str = "U", with_info: bool = False):
     """Cholesky factor of SPD A: upper R with A = RᵀR (uplo='U') or lower L
     with A = LLᵀ (uplo='L').  Reference lapack::engine::_potrf
-    (interface.hpp:30-44)."""
+    (interface.hpp:30-44).
+
+    with_info=True additionally returns the LAPACK-style int32 status of
+    the factor (robust/detect.factor_info; 0 = clean) — lax.linalg.cholesky
+    itself NaN-fills silently on breakdown."""
+    A = faultinject.tap(A)
     L = lax.linalg.cholesky(A.astype(_compute_dtype(A.dtype)))
     L = L.astype(A.dtype)
-    return L.T if uplo == "U" else L
+    T = L.T if uplo == "U" else L
+    return (T, detect.factor_info(T)) if with_info else T
 
 
 def trtri(T: jnp.ndarray, uplo: str = "U", unit_diag: bool = False) -> jnp.ndarray:
@@ -197,11 +208,14 @@ def trtri_stack(
     return W.astype(D.dtype)
 
 
-def potrf_trtri(A: jnp.ndarray, uplo: str = "U") -> tuple[jnp.ndarray, jnp.ndarray]:
+def potrf_trtri(A: jnp.ndarray, uplo: str = "U", with_info: bool = False):
     """Fused base-case pair: factor + triangular inverse in one call — the
     reference base case always computes both back to back
     (cholinv policy.h:197-201).  The factor stays at the compute dtype
-    between the two steps (no intermediate downcast)."""
+    between the two steps (no intermediate downcast).
+
+    with_info=True appends the int32 breakdown status of the factor."""
+    A = faultinject.tap(A)
     ct = _compute_dtype(A.dtype)
     L = lax.linalg.cholesky(A.astype(ct))
     T = L.T if uplo == "U" else L
@@ -209,10 +223,11 @@ def potrf_trtri(A: jnp.ndarray, uplo: str = "U") -> tuple[jnp.ndarray, jnp.ndarr
     Tinv = lax.linalg.triangular_solve(
         T, eye, left_side=True, lower=(uplo == "L")
     )
-    return T.astype(A.dtype), Tinv.astype(A.dtype)
+    T, Tinv = T.astype(A.dtype), Tinv.astype(A.dtype)
+    return (T, Tinv, detect.factor_info(T)) if with_info else (T, Tinv)
 
 
-def potrf_trtri_upper(P: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+def potrf_trtri_upper(P: jnp.ndarray, with_info: bool = False):
     """(R, R⁻¹) upper-triangular from a symmetric panel whose **upper**
     triangle holds the valid content (the lower half may be garbage — e.g. a
     Schur window produced by an uplo='U' syrk).
@@ -225,9 +240,12 @@ def potrf_trtri_upper(P: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     (~4.7ms/iter at n=16k on v5e).  Here cholesky/triangular_solve run in
     their native lower form (no symmetrize pass: cholesky with
     symmetrize_input=False reads only the lower triangle) and the three
-    transposes stay panel-sized."""
+    transposes stay panel-sized.
+
+    with_info=True appends the int32 breakdown status of R."""
     from capital_tpu.ops import pallas_tpu
 
+    P = faultinject.tap(P)
     ct = _compute_dtype(P.dtype)
     P_low = pallas_tpu.transpose(P, out_uplo="L", out_dtype=ct)
     L = lax.linalg.cholesky(P_low, symmetrize_input=False)
@@ -235,7 +253,7 @@ def potrf_trtri_upper(P: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     Linv = lax.linalg.triangular_solve(L, eye, left_side=True, lower=True)
     R = pallas_tpu.transpose(L, out_uplo="U", out_dtype=P.dtype)
     Rinv = pallas_tpu.transpose(Linv, out_uplo="U", out_dtype=P.dtype)
-    return R, Rinv
+    return (R, Rinv, detect.factor_info(R)) if with_info else (R, Rinv)
 
 
 def geqrf(A: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:

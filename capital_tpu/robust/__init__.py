@@ -1,0 +1,19 @@
+"""Breakdown detection, shifted-CholeskyQR recovery, and fault injection.
+
+See docs/ROBUSTNESS.md for the full story: detection semantics
+(robust/detect), the shift formula and sCQR3 escalation (robust/recovery),
+deterministic fault planting (robust/faultinject), and the sweep failure
+containment that lives in bench/harness + autotune/sweep.
+"""
+
+from capital_tpu.robust import detect, faultinject, recovery
+from capital_tpu.robust.config import CholEvent, RobustConfig, RobustInfo
+
+__all__ = [
+    "CholEvent",
+    "RobustConfig",
+    "RobustInfo",
+    "detect",
+    "faultinject",
+    "recovery",
+]

@@ -1,0 +1,64 @@
+"""Robustness configuration + the jit-friendly status pytree.
+
+Kept dependency-light (jax only): ops/lapack.py and models/cholesky.py both
+import from here, so this module must not import back into the algorithm
+layers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+
+@dataclasses.dataclass(frozen=True)
+class RobustConfig:
+    """Breakdown detection + shifted-CholeskyQR recovery knobs.
+
+    CholeskyQR2's gram squares the condition number, so the method silently
+    NaN-fills past cond(A) ~ u^{-1/2} (CA-CQR2, arXiv:1710.08471 §2; unlike
+    the unconditionally stable TSQR family, arXiv:0809.2407).  With a
+    RobustConfig attached (CacqrConfig.robust / CholinvConfig.robust) every
+    Cholesky site returns a LAPACK-`info`-style status, and qr.factor
+    recovers in-graph via the shifted CholeskyQR of Fukaya et al.:
+
+        sigma = shift_c * u * (m*n + n*(n+1)) * tr(G)
+
+    re-factoring G + sigma*I bounds cond(A * R^-1) regardless of cond(A),
+    and the following sweep(s) restore orthogonality (sCQR3 escalation when
+    the gate still exceeds `ortho_tol`).  The healthy path pays only the
+    cheap n x n status reductions.
+
+    shift_c: the constant c in the shift formula (11 in the sCQR analysis).
+    ortho_tol: escalation gate on ||I - Q^T Q||_F / sqrt(n); None derives
+        100 * n * u at the factor's compute dtype.
+    recover: False = detect only (status reported, no shifted re-factor).
+    escalate: False = never run the third (sCQR3) sweep.
+    """
+
+    shift_c: float = 11.0
+    ortho_tol: float | None = None
+    recover: bool = True
+    escalate: bool = True
+
+
+class RobustInfo(NamedTuple):
+    """Aggregated robust status of one qr.factor call (a pytree of scalars,
+    jit/vmap-safe).  `info` follows the LAPACK potrf convention per site
+    (see robust/detect.factor_info) aggregated by max AFTER recovery: 0
+    means every factor in the pipeline is clean post-recovery."""
+
+    info: object  # int32: max residual factor_info after recovery (0 = ok)
+    breakdown: object  # int32: chol sites whose unshifted factor broke
+    shifted: object  # int32: sites re-factored with the gram shift
+    sigma: object  # float32: largest shift applied (0.0 on the healthy path)
+    escalated: object  # int32: 1 when the sCQR3 third sweep ran
+    ortho: object  # float32: escalation gate value; -1.0 when not computed
+
+
+class CholEvent(NamedTuple):
+    """Per-site record from robust/recovery.guarded_chol."""
+
+    info: object  # int32 status of the unshifted factor
+    sigma: object  # shift actually applied (0 when the factor was healthy)
+    info_after: object  # int32 status of the returned (possibly shifted) factor

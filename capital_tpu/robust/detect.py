@@ -1,0 +1,45 @@
+"""In-graph breakdown detection for Cholesky factors.
+
+`lax.linalg.cholesky` has no `info` output: on an indefinite input the CPU
+LAPACK kernel reports info > 0 and jax converts that to a silent NaN fill;
+on TPU the rank-deficient trailing blocks produce NaN/Inf directly.  Either
+way the breakdown is recoverable *from the factor itself* — a clean
+Cholesky factor has a finite, strictly positive diagonal.  `factor_info`
+reduces that predicate to a LAPACK-style int32 scalar that stays inside the
+jit program (no host sync), so callers can branch on it with `lax.cond`.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def factor_info(R) -> jnp.ndarray:
+    """LAPACK `potrf`-style status for a triangular factor R (n x n).
+
+    Returns int32:
+      0      -- healthy: finite everywhere, diagonal strictly positive.
+      k in [1, n] -- 1-based index of the first non-finite or non-positive
+                diagonal entry (the LAPACK convention: the leading (k-1)
+                minor factored fine, order k did not).
+      n + 1  -- diagonal is clean but an off-diagonal entry is non-finite
+                (seen when a NaN contaminates the triangular solve rather
+                than the factorization itself).
+
+    Works on either triangle convention (only the diagonal sign matters)
+    and is jit/vmap-safe: a pure O(n^2) reduction, no host callback.
+    """
+    d = jnp.diagonal(R)
+    bad_diag = ~(jnp.isfinite(d) & (d > 0))
+    # argmax on bool gives the first True; guard with any() so an all-good
+    # diagonal maps to 0 rather than index-0's "1".
+    first_bad = jnp.where(
+        jnp.any(bad_diag), jnp.argmax(bad_diag).astype(jnp.int32) + 1, 0
+    )
+    off_bad = ~jnp.all(jnp.isfinite(R))
+    n = R.shape[-1]
+    return jnp.where(
+        first_bad > 0,
+        first_bad,
+        jnp.where(off_bad, jnp.int32(n + 1), jnp.int32(0)),
+    ).astype(jnp.int32)

@@ -1,0 +1,128 @@
+"""Deterministic fault injection keyed on tracing.PHASE_REGISTRY tags.
+
+Breakdown and recovery paths are hard to exercise organically — a TPU pod
+OOM or a rank-collapsed gram shows up once a week, not once a test run.
+This module plants faults at the phase-tagged taps the ops layer exposes
+(`tap(x)` calls inside ops/lapack and models/qr): a `Fault` names a phase
+tag from tracing.PHASE_REGISTRY, which occurrence of that tag to hit, and
+the corruption to apply.  Injection is positional and host-side, so the
+same plan always corrupts the same site — deterministic on the CPU rig.
+
+    with faultinject.active_plan(
+        faultinject.Fault(tag="CQR::gram", kind="rank_deficient")
+    ) as plan:
+        Q, R, info = qr.factor(grid, A, cfg_with_robust)
+    assert plan.fired == [("CQR::gram", 0)]
+
+Caveat: taps fire at *trace* time.  Under jit the corruption bakes into
+the compiled program (fine for testing recovery); both branches of a
+lax.cond are traced, so taps inside guarded recovery branches also fire —
+prefer injecting at sites outside the cond (e.g. CQR::gram) when counting
+occurrences.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from capital_tpu.utils import tracing
+
+_KINDS = ("nan", "inf", "rank_deficient", "raise")
+
+
+class FaultInjected(jax.errors.JaxRuntimeError):
+    """Raised by kind='raise' faults.  Subclasses JaxRuntimeError (the
+    XlaRuntimeError alias) so the bench/autotune containment layer treats
+    an injected failure exactly like a real device-side abort."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Fault:
+    """One planted fault.
+
+    tag: a phase tag registered in tracing.PHASE_REGISTRY (ValueError
+        otherwise — typos must not silently never fire).
+    kind: 'nan' / 'inf' poison one element; 'rank_deficient' zeroes the
+        last row+column (a singular but finite gram — the shifted-retry
+        case); 'raise' throws FaultInjected at trace time (the sweep
+        containment case).
+    index: which occurrence of `tag` to hit (0-based, counted per plan).
+    count: how many consecutive occurrences from `index` to corrupt.
+    """
+
+    tag: str
+    kind: str = "nan"
+    index: int = 0
+    count: int = 1
+
+    def __post_init__(self):
+        if self.tag not in tracing.PHASE_REGISTRY:
+            raise ValueError(
+                f"fault tag {self.tag!r} not in tracing.PHASE_REGISTRY; "
+                f"known tags: {sorted(tracing.PHASE_REGISTRY)}"
+            )
+        if self.kind not in _KINDS:
+            raise ValueError(f"fault kind {self.kind!r} not in {_KINDS}")
+
+
+class FaultPlan:
+    """Active set of faults plus the deterministic firing record."""
+
+    def __init__(self, faults):
+        self.faults = tuple(faults)
+        self.hits = collections.Counter()  # tag -> occurrences seen
+        self.fired: list[tuple[str, int]] = []  # (tag, occurrence) applied
+
+    def corrupt(self, x, tag: str):
+        occ = self.hits[tag]
+        self.hits[tag] += 1
+        for f in self.faults:
+            if f.tag == tag and f.index <= occ < f.index + f.count:
+                self.fired.append((tag, occ))
+                if f.kind == "raise":
+                    raise FaultInjected(
+                        f"injected fault at {tag!r} occurrence {occ}"
+                    )
+                x = _corrupt_array(x, f.kind)
+        return x
+
+
+def _corrupt_array(x, kind: str):
+    if kind == "rank_deficient":
+        if x.ndim < 2:
+            return jnp.zeros_like(x)
+        return x.at[..., -1, :].set(0).at[..., :, -1].set(0)
+    val = jnp.nan if kind == "nan" else jnp.inf
+    return x.at[(0,) * x.ndim].set(jnp.asarray(val, x.dtype))
+
+
+_PLANS: list[FaultPlan] = []
+
+
+@contextlib.contextmanager
+def active_plan(*faults: Fault):
+    """Activate a fault plan for the enclosed region; yields the plan so
+    tests can assert on `plan.fired` afterwards."""
+    plan = FaultPlan(faults)
+    _PLANS.append(plan)
+    try:
+        yield plan
+    finally:
+        _PLANS.remove(plan)
+
+
+def tap(x, point: str | None = None):
+    """Fault-injection tap.  Identity when no plan is active (the hot-path
+    cost is one list truthiness check).  The site key is `point` if given,
+    else the innermost active tracing scope."""
+    if not _PLANS:
+        return x
+    tag = point or tracing.current_scope() or "<top>"
+    for plan in _PLANS:
+        x = plan.corrupt(x, tag)
+    return x

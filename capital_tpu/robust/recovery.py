@@ -1,0 +1,87 @@
+"""Shifted-CholeskyQR recovery for broken gram factorizations.
+
+On breakdown (robust/detect.factor_info != 0) the gram matrix G = A^T A is
+numerically indefinite.  The sCQR fix (Fukaya, Kannan, Nakatsukasa, Yamamoto,
+Yanagisawa, "Shifted Cholesky QR for computing the QR factorization of
+ill-conditioned matrices") re-factors the shifted gram
+
+    G + sigma * I,   sigma = c * u * (m*n + n*(n+1)) * tr(G),   c = 11
+
+which is SPD whenever the unshifted factorization can fail in floating
+point, and bounds cond(A R^{-1}) <= O(u^{-1/2}) regardless of cond(A) —
+small enough that the *next* CholeskyQR sweep is unconditionally safe.
+tr(G) = ||A||_F^2 >= ||A||_2^2 serves as the cheap spectral-norm
+overestimate the analysis needs.
+
+`guarded_chol` wraps any (G -> (R, Rinv)) factorizer with detection plus a
+`lax.cond` shifted retry, so the healthy path pays one O(n^2) status
+reduction and the recovery work compiles into the cold branch.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from capital_tpu.robust import detect
+from capital_tpu.robust.config import CholEvent, RobustConfig
+from capital_tpu.utils import tracing
+
+
+def unit_roundoff(dtype) -> float:
+    """u for the *compute* dtype: sub-f32 inputs are factored in f32 by
+    ops/lapack (see lapack._compute_dtype), so their effective roundoff is
+    f32's."""
+    dt = jnp.dtype(dtype)
+    if dt.itemsize < jnp.dtype(jnp.float32).itemsize:
+        dt = jnp.dtype(jnp.float32)
+    return float(jnp.finfo(dt).eps)
+
+
+def sigma_shift(G, m_rows: int, c: float = 11.0):
+    """The sCQR shift sigma = c*u*(m*n + n*(n+1))*tr(G), in G's dtype.
+
+    The trace is read off the diagonal only, so the formula stays valid for
+    upper-triangular-valid grams (the dist pipeline's G carries garbage
+    below the diagonal)."""
+    n = G.shape[-1]
+    u = unit_roundoff(G.dtype)
+    tr = jnp.sum(jnp.diagonal(G))
+    return (c * u * (m_rows * n + n * (n + 1))) * tr
+
+
+def guarded_chol(G, m_rows: int, rcfg: RobustConfig | None, chol_fn):
+    """Factor G via chol_fn with breakdown detection + shifted retry.
+
+    chol_fn: G -> (R, Rinv).  Returns (R, Rinv, CholEvent).  With rcfg None
+    or rcfg.recover False this is detect-only: the unshifted factor is
+    returned with its status and sigma = 0.
+
+    The shifted branch re-runs chol_fn under tracing.muted(): both lax.cond
+    branches are traced, so without muting every guarded site would
+    double-count its phase flops in the cost model.  The audit layer still
+    sees the recovery ops in the compiled program (bench/trace buckets them
+    from the HLO, not from emit()).
+    """
+    R, Rinv = chol_fn(G)
+    info = detect.factor_info(R)
+    if rcfg is None or not rcfg.recover:
+        zero = jnp.zeros((), G.dtype)
+        return R, Rinv, CholEvent(info=info, sigma=zero, info_after=info)
+
+    sigma = sigma_shift(G, m_rows, c=rcfg.shift_c)
+
+    def _shifted(_):
+        with tracing.muted():
+            n = G.shape[-1]
+            Gs = G + sigma * jnp.eye(n, dtype=G.dtype)
+            return chol_fn(Gs)
+
+    def _keep(_):
+        return R, Rinv
+
+    R2, Rinv2 = lax.cond(info != 0, _shifted, _keep, operand=None)
+    applied = jnp.where(info != 0, sigma, jnp.zeros((), sigma.dtype))
+    return R2, Rinv2, CholEvent(
+        info=info, sigma=applied, info_after=detect.factor_info(R2)
+    )

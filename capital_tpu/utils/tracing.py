@@ -112,9 +112,11 @@ PHASE_REGISTRY: tuple[str, ...] = (
     # cholinv (cholesky.py, reference cholinv.hpp:94-136)
     "CI::factor_diag", "CI::trsm", "CI::tmu", "CI::inv",
     # cacqr (qr.py, reference cacqr.hpp:82-116; CQR::scale is historical —
-    # kept so old traces/ledgers still bucket)
+    # kept so old traces/ledgers still bucket).  CQR::recover is the
+    # shifted-CholeskyQR escalation path (robust/recovery.py) — present in
+    # the program only under a RobustConfig, executed only on breakdown.
     "CQR::gram", "CQR::chol", "CQR::scale", "CQR::merge", "CQR::fused",
-    "CQR::formR",
+    "CQR::formR", "CQR::recover",
     # rectri (inverse.py)
     "RT::base", "RT::merge", "RT::batch_base", "RT::batch_merge",
     "RT::batch_write",
@@ -138,6 +140,33 @@ def register_phase(tag: str) -> str:
 
 _SCOPE_STACK: list[str] = []
 _ACTIVE: list["Recorder"] = []
+_MUTED: list[bool] = []
+
+
+def current_scope() -> str | None:
+    """Innermost active phase tag, or None outside every scope().  This is
+    the key the fault-injection taps (robust/faultinject.py) resolve their
+    site against — exposed as a function so callers never reach into the
+    stack directly."""
+    return _SCOPE_STACK[-1] if _SCOPE_STACK else None
+
+
+@contextlib.contextmanager
+def muted():
+    """Suppress emit()/note() attribution for the enclosed trace region.
+
+    The robust recovery branches (robust/recovery.guarded_chol, the sCQR3
+    escalation in models/qr.py) re-trace the same phase ops inside a
+    lax.cond — at runtime only the taken branch executes, but trace-time
+    emits would fire for BOTH, double-counting the cost model and poisoning
+    the model-vs-compiled drift gate for the healthy path the model is
+    meant to price.  Recovery work is therefore traced muted: the model
+    describes the healthy path, the audit sees the full program."""
+    _MUTED.append(True)
+    try:
+        yield
+    finally:
+        _MUTED.pop()
 
 
 @dataclasses.dataclass
@@ -213,7 +242,7 @@ def emit(
     unless a Recorder is active (zero overhead in production paths).
     flops_vol/flops_max (executed volumetric / max-per-process views)
     default to `flops` — the homogeneous assumption."""
-    if not _ACTIVE:
+    if not _ACTIVE or _MUTED:
         return
     tag = _SCOPE_STACK[-1] if _SCOPE_STACK else "<top>"
     for rec in _ACTIVE:
@@ -230,6 +259,8 @@ def note(tag: str) -> None:
     """Count-only event under its own tag (not the scope stack) — used for
     trace-time telemetry like layout-fallback occurrences.  No-op without an
     active Recorder."""
+    if _MUTED:
+        return
     for rec in _ACTIVE:
         rec.stats[tag].calls += 1
 

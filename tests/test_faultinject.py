@@ -1,0 +1,141 @@
+"""Fault-injection tests: deterministic corruption at phase-tagged taps,
+detection + recovery of the injected breakdown, and the raise kind that
+exercises the sweep containment path (docs/ROBUSTNESS.md)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from capital_tpu.models import qr
+from capital_tpu.models.qr import CacqrConfig
+from capital_tpu.parallel.topology import Grid
+from capital_tpu.robust import RobustConfig, faultinject as fi, recovery
+
+
+def _grid1():
+    return Grid.square(c=1, devices=[jax.devices()[0]])
+
+
+def _well(m=256, n=32, seed=7):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.standard_normal((m, n)) / np.sqrt(m), jnp.float64)
+
+
+class TestPlanMechanics:
+    def test_tap_identity_without_plan(self):
+        x = jnp.arange(6.0).reshape(2, 3)
+        assert fi.tap(x) is x
+
+    def test_unknown_tag_rejected(self):
+        with pytest.raises(ValueError, match="not in tracing.PHASE_REGISTRY"):
+            fi.Fault(tag="CQR::nope")
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError, match="fault kind"):
+            fi.Fault(tag="CQR::gram", kind="meteor")
+
+    def test_occurrence_selection_is_deterministic(self):
+        f = fi.Fault(tag="CQR::gram", kind="nan", index=1)
+        x = jnp.ones((2, 2))
+        with fi.active_plan(f) as plan:
+            y0 = fi.tap(x, point="CQR::gram")  # occurrence 0: untouched
+            y1 = fi.tap(x, point="CQR::gram")  # occurrence 1: poisoned
+        assert bool(jnp.all(jnp.isfinite(y0)))
+        assert not bool(jnp.all(jnp.isfinite(y1)))
+        assert plan.fired == [("CQR::gram", 1)]
+
+    def test_raise_is_a_jax_runtime_error(self):
+        assert issubclass(fi.FaultInjected, jax.errors.JaxRuntimeError)
+        with fi.active_plan(fi.Fault(tag="CQR::gram", kind="raise")):
+            with pytest.raises(fi.FaultInjected):
+                fi.tap(jnp.ones(2), point="CQR::gram")
+
+    def test_rank_deficient_zeroes_border(self):
+        x = jnp.ones((4, 4))
+        with fi.active_plan(fi.Fault(tag="CQR::gram", kind="rank_deficient")):
+            y = fi.tap(x, point="CQR::gram")
+        assert bool(jnp.all(y[-1, :] == 0)) and bool(jnp.all(y[:, -1] == 0))
+        assert bool(jnp.all(y[:-1, :-1] == 1))
+
+
+class TestInjectedBreakdown:
+    def test_nan_gram_detected(self):
+        g = _grid1()
+        A = _well()
+        cfg = CacqrConfig(regime="1d", robust=RobustConfig())
+        with fi.active_plan(fi.Fault(tag="CQR::gram", kind="nan")) as plan:
+            Q, R, ri = qr.factor(g, A, cfg)
+        assert plan.fired and plan.fired[0] == ("CQR::gram", 0)
+        assert int(ri.breakdown) > 0  # the poisoned gram broke the factor
+
+    def test_rank_deficient_gram_recovers(self):
+        # a singular-but-finite gram is exactly the shifted-retry case:
+        # the shift restores positive-definiteness and sCQR3 polishes
+        g = _grid1()
+        A = _well()
+        n = A.shape[1]
+        cfg = CacqrConfig(regime="1d", robust=RobustConfig())
+        with fi.active_plan(
+            fi.Fault(tag="CQR::gram", kind="rank_deficient")
+        ) as plan:
+            Q, R, ri = qr.factor(g, A, cfg)
+        assert plan.fired == [("CQR::gram", 0)]
+        assert int(ri.breakdown) > 0
+        assert int(ri.shifted) > 0
+        assert bool(jnp.all(jnp.isfinite(Q)))
+        # note the CONTRACT here: info reports honestly — the corrupted
+        # gram no longer describes A, so we assert finiteness + flags, not
+        # orthogonality of Q against the uncorrupted A
+        assert int(ri.info) in (0, n + 2)
+
+    def test_without_robust_nan_propagates(self):
+        g = _grid1()
+        A = _well()
+        cfg = CacqrConfig(regime="1d")
+        with fi.active_plan(fi.Fault(tag="CQR::gram", kind="nan")):
+            Q, R = qr.factor(g, A, cfg)
+        assert not bool(jnp.all(jnp.isfinite(Q)))  # the baseline failure
+
+    def test_plan_scopes_cleanly(self):
+        # after the context exits, factorization is pristine again
+        g = _grid1()
+        A = _well()
+        cfg = CacqrConfig(regime="1d", robust=RobustConfig())
+        with fi.active_plan(fi.Fault(tag="CQR::gram", kind="nan")):
+            qr.factor(g, A, cfg)
+        Q, R, ri = qr.factor(g, A, cfg)
+        assert int(ri.breakdown) == 0 and int(ri.info) == 0
+
+
+class TestContainmentPath:
+    def test_injected_raise_contained_by_run_guarded(self):
+        from capital_tpu.bench import harness
+
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise fi.FaultInjected("injected")
+            return 42
+
+        out, attempts = harness.run_guarded(
+            flaky, policy=harness.RetryPolicy(retries=1, backoff_s=0.0),
+            label="t",
+        )
+        assert out == 42 and attempts == 2
+
+    def test_exhausted_retries_raise_config_failed(self):
+        from capital_tpu.bench import harness
+
+        def always():
+            raise fi.FaultInjected("injected")
+
+        with pytest.raises(harness.ConfigFailed) as ei:
+            harness.run_guarded(
+                always, policy=harness.RetryPolicy(retries=1, backoff_s=0.0),
+                label="cfg7",
+            )
+        assert ei.value.label == "cfg7" and ei.value.attempts == 2
+        assert isinstance(ei.value.cause, jax.errors.JaxRuntimeError)

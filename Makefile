@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all test benchmarking tune audit robust native clean
+.PHONY: all test benchmarking bench-explicit tune audit robust native clean
 
 all: test
 
@@ -16,6 +16,19 @@ test:
 # are modules — run the whole driver suite on small shapes as a smoke
 benchmarking:
 	$(PY) -m capital_tpu.bench suite --n 1024 --m 8192 --k 256
+
+# explicit-path constant tracker (docs/DISTRIBUTED.md "2.33x -> parity"):
+# bench the explicit cholinv schedule and its persistent tile-cyclic
+# spelling, appending unified ledger rows (measured + model copy-bytes +
+# audit) so the BENCH/MULTICHIP trajectories carry the closure instead of
+# it living only in docs.  Smoke shapes here; the flagship row on a TPU is
+# --n 16384 --devices 1 (round-4 constant: 35.4 vs 68.0 TF/s).
+bench-explicit:
+	$(PY) -m capital_tpu.bench cholinv --n 1024 --mode explicit \
+		--validate --ledger bench_explicit.jsonl
+	$(PY) -m capital_tpu.bench cholinv --n 1024 --mode explicit \
+		--balance tile_cyclic_persistent --devices 4 \
+		--validate --ledger bench_explicit.jsonl
 
 tune:
 	$(PY) -m capital_tpu.autotune cholinv --n 2048 --out autotune_out
@@ -37,5 +50,5 @@ native:
 	$(PY) -c "from capital_tpu import native; print('native engine available:', native.available())"
 
 clean:
-	rm -rf autotune_out .pytest_cache
+	rm -rf autotune_out .pytest_cache bench_explicit.jsonl
 	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -442,20 +442,28 @@ def cholinv_space(
     splits: Iterable[int] = (1,),
     modes: Iterable[str] = ("xla",),
     grids: Iterable[Grid] | None = None,
+    balances: Iterable[str] = ("block",),
 ):
-    """policy x bc x split x mode (x grid shape) — the reference's
-    decomposition sweep (cholesky tune.cpp:175-253: 3 policies x
-    bcMultiplier range) plus the rep-factor/grid-shape axis (`grids`,
+    """policy x bc x split x mode (x grid shape) (x balance) — the
+    reference's decomposition sweep (cholesky tune.cpp:175-253: 3 policies
+    x bcMultiplier range) plus the rep-factor/grid-shape axis (`grids`,
     e.g. from grid_space()).  The operand reshards to each grid's face on
     the first in-loop iteration; subsequent iterations carry the face
-    layout, so the measured steady-state time is that grid's."""
+    layout, so the measured steady-state time is that grid's.  `balances`
+    adds the schedule axis ('block' / 'tile_cyclic' /
+    'tile_cyclic_persistent', explicit mode only) — the planner prices the
+    copy-bytes difference, so the persistent spelling ranks on the model,
+    not only in the measured sweep."""
     prec = None if jnp.dtype(dtype).itemsize < 4 else "highest"
     glist = _with_grids(grids, grid)
-    for g, pol, bc, split, mode in itertools.product(
-        glist, policies, bc_dims, splits, modes
+    for g, pol, bc, split, mode, bal in itertools.product(
+        glist, policies, bc_dims, splits, modes, balances
     ):
+        if bal != "block" and mode != "explicit":
+            continue  # balanced schedules are explicit-only (cholesky.factor raises)
         cfg = cholesky.CholinvConfig(
-            base_case_dim=bc, split=split, policy=pol, mode=mode, precision=prec
+            base_case_dim=bc, split=split, policy=pol, mode=mode,
+            precision=prec, balance=bal,
         )
 
         def step(a, cfg=cfg, g=g):
@@ -463,9 +471,13 @@ def cholinv_space(
             return R + Rinv
 
         cid = f"pol{pol.value}_bc{bc}_s{split}_{mode}"
+        if bal != "block":
+            cid += f"_{bal}"
         cdict = {
             "policy": pol.name, "base_case_dim": bc, "split": split, "mode": mode,
         }
+        if bal != "block":
+            cdict["balance"] = bal
         if grids is not None:
             # topology parameters ride the config dict whenever a grids
             # axis was passed — even a single-element axis may differ from
@@ -626,6 +638,7 @@ def tune_cholinv(
                 itemsize=jnp.dtype(dtype).itemsize,
                 split=cdict["split"],
                 num_chunks=q,
+                balance=cdict.get("balance", "block"),
             )
             preds.append(float(out[0, 0]))
         order = sorted(range(len(configs)), key=preds.__getitem__)

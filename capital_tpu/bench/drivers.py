@@ -290,6 +290,7 @@ def cholinv(args) -> dict:
         split=args.split,
         base_case_dim=bc,
         mode=mode,
+        balance=getattr(args, "balance", "block"),
         precision=_precision(args, dtype),
     )
     A = _spd(args.n, dtype)
@@ -302,7 +303,7 @@ def cholinv(args) -> dict:
     flops = 2.0 * args.n**3 / 3.0  # factor n³/3 + triangular inverse n³/3
     rec = harness.report(
         "cholinv_tflops", t, flops, dtype, n=args.n, grid=repr(grid), bc=bc,
-        mode=mode, **_knobs(args), **extra,
+        mode=mode, balance=cfg.balance, **_knobs(args), **extra,
     )
     if args.validate:
         R, Rinv = jax.jit(lambda a: cholesky.factor(grid, a, cfg))(A)
@@ -777,6 +778,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--mode", default="auto", choices=["auto", "xla", "explicit", "pallas"],
         help="SUMMA mode; auto = pallas on one device, xla on a mesh",
+    )
+    p.add_argument(
+        "--balance", default="block",
+        choices=["block", "tile_cyclic", "tile_cyclic_persistent"],
+        help="cholinv: explicit-mode triangular work balance; "
+        "tile_cyclic_persistent permutes once per factor lifetime instead "
+        "of per trmm/syrk call (docs/DISTRIBUTED.md)",
     )
     p.add_argument("--variant", type=int, default=2, help="1=CQR, 2=CQR2")
     p.add_argument("--regime", default="auto", choices=["auto", "1d", "dist"])

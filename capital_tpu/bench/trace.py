@@ -199,6 +199,35 @@ def _critical_plane_budget(spaces) -> dict[str, float]:
     return dict(crit)
 
 
+def check_copy_fraction(
+    budget: dict[str, float], max_frac: float, label: str = ""
+) -> float:
+    """Gate the 'copy' bucket — schedule-inserted relayout/materialization
+    copies, the device-time cost the copy-free explicit routes and the
+    persistent tile-cyclic layout exist to remove — at <= ``max_frac`` of
+    the plane's compute own-time.  Returns the measured fraction; raises
+    RuntimeError on violation so copy regressions fail as loudly as
+    collective-inventory regressions (tests/test_collective_audit.py)
+    already do.  The async-occupancy row is excluded from both sides
+    (it overlaps compute; it is not additive own-time).  The cost-model
+    counterpart is the copy_bytes column of tracing.Recorder
+    (docs/OBSERVABILITY.md)."""
+    compute = {
+        k: v for k, v in budget.items() if k != "async (overlapped)"
+    }
+    total = sum(compute.values())
+    frac = (compute.get("copy", 0.0) / total) if total > 0 else 0.0
+    if frac > max_frac:
+        raise RuntimeError(
+            f"copy-budget regression{f' ({label})' if label else ''}: "
+            f"copy bucket is {100 * frac:.1f}% of device own-time, "
+            f"budget {100 * max_frac:.1f}% — a schedule copy "
+            "(take_triangle materialization / whole-buffer "
+            "dynamic_update_slice) crept back in"
+        )
+    return frac
+
+
 def print_budget(budget: dict[str, float], iters: int, label: str) -> dict:
     budget = dict(budget)
     async_ms = budget.pop("async (overlapped)", 0.0)
@@ -402,6 +431,11 @@ def main(argv=None) -> None:
                         "flagship protocol) instead of the carry loop")
     p.add_argument("--trace-dir", default=None,
                    help="keep the raw trace here instead of a temp dir")
+    p.add_argument("--max-copy-frac", type=float, default=None,
+                   help="fail (non-zero exit) if the 'copy' bucket exceeds "
+                        "this fraction of device own-time — the CI gate for "
+                        "schedule-copy regressions (see "
+                        "trace.check_copy_fraction)")
     p.add_argument("--precision", default=None,
                    choices=["default", "high", "highest"],
                    help="override the matmul precision ('high' traces the "
@@ -434,6 +468,12 @@ def main(argv=None) -> None:
 
     budget = device_budget(run, args.trace_dir)
     print_budget(budget, args.iters, label)
+    if args.max_copy_frac is not None:
+        frac = check_copy_fraction(budget, args.max_copy_frac, label)
+        print(
+            f"# copy budget OK: {100 * frac:.1f}% <= "
+            f"{100 * args.max_copy_frac:.1f}%"
+        )
 
 
 if __name__ == "__main__":

@@ -54,7 +54,7 @@ from capital_tpu.robust import detect
 from capital_tpu.robust.config import RobustConfig
 from capital_tpu.parallel.summa import SyrkArgs, TrmmArgs
 from capital_tpu.parallel.topology import Grid
-from capital_tpu.utils import tracing
+from capital_tpu.utils import jax_compat, tracing
 from capital_tpu.utils.config import BaseCasePolicy
 
 
@@ -99,6 +99,17 @@ class CholinvConfig:
     # small ones keep the block schedule (and side-R completion trmms
     # always do; the balanced form is side-L/syrk only).  No effect
     # outside explicit mode.
+    # 'tile_cyclic_persistent' instead permutes the WHOLE matrix into the
+    # symmetric tile-cyclic layout ONCE at factor entry (tile =
+    # base_case_dim // d, so every recursion window stays aligned) and
+    # un-permutes R / Rinv once at exit: three lifetime shuffles replace
+    # the 2-3 per trmm/syrk call of 'tile_cyclic', every phase (including
+    # the side-R completion trmms and the base-case windows) runs
+    # balanced, and the per-call min_window economics disappear — so
+    # balance_min_window is ignored.  Requires mode='explicit'; topologies
+    # the layout cannot cover (d==1, c>1, non-square faces, base_case_dim
+    # not divisible by d, or an unaligned split plan) fall back to the
+    # block schedule with a 'cholinv::persistent_fallback' tracing note.
     balance_min_window: int = 8192
     schur_in_place: bool = False  # write each Schur complement back into the
     # input buffer (summa.syrk in_place) instead of materializing the
@@ -200,6 +211,34 @@ def plan(n: int, cfg: CholinvConfig, off: int = 0) -> PlanNode:
     return PlanNode(off=off, n=n, is_base=False, top=(left, right))
 
 
+def persistent_tile(grid: Grid, node: PlanNode, cfg: CholinvConfig) -> int:
+    """The layout tile for balance='tile_cyclic_persistent', or 0 when the
+    topology/plan cannot hold the layout.  t = base_case_dim // d makes the
+    layout's alignment quantum d*t == base_case_dim, and since every window
+    of an aligned plan sits on a base_case_dim boundary, EVERY view of the
+    recursion extracts/updates cleanly (parallel/summa.cyclic_window) —
+    this is what lets one entry permute serve the whole factorization."""
+    d = grid.dx
+    if not (
+        cfg.mode == "explicit"
+        and grid.c == 1
+        and grid.dy == d
+        and d > 1
+        and max(1, grid.num_chunks) == 1
+        and cfg.base_case_dim % d == 0
+    ):
+        return 0
+
+    bc = cfg.base_case_dim
+
+    def aligned(nd: PlanNode) -> bool:
+        if nd.off % bc or nd.n % bc:
+            return False
+        return nd.is_base or all(aligned(c) for c in nd.top)
+
+    return bc // d if aligned(node) else 0
+
+
 # --------------------------------------------------------------------------
 # execute: the traced recursion (reference `invoke`, cholinv.hpp:87-165)
 # --------------------------------------------------------------------------
@@ -214,10 +253,18 @@ def _base_case_into(
     cfg: CholinvConfig,
     Rp: jnp.ndarray,
     RIp: jnp.ndarray,
+    ptile: int = 0,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Leaf factorization: gather + local potrf/trtri (policy.h:160-224),
     reading the window (off, off, n, n) of `buf` (upper triangle valid) and
     writing the R / R⁻¹ blocks into Rp / RIp at diagonal offset `dest`.
+
+    ptile != 0 (balance='tile_cyclic_persistent'): all three buffers are in
+    the symmetric tile-cyclic storage layout — the window is extracted with
+    a chunk-local reshape (summa.cyclic_window), locally un-permuted on the
+    replicated panel (a bc x bc gather, free next to the potrf), factored,
+    re-permuted, and written back in layout (band-sized update, no
+    whole-buffer dus).
 
     The panel is replicated (XLA emits one all_gather over the mesh); which
     devices then FACTOR it is the policy (see _scoped_base_factor): every
@@ -266,11 +313,35 @@ def _base_case_into(
                 Linv, out_uplo="U", out=RIp, out_off=(dest, dest)
             )
             return Rp, RIp
+        if ptile:
+            wperm, winv = summa.tile_cyclic_perm(n, grid.dx, ptile)
+            window = summa.cyclic_window(
+                buf, (off, off, n, n), grid.dx, ptile
+            ).astype(bc_dtype)
+            window = lax.with_sharding_constraint(
+                window, grid.replicated_sharding()
+            )
+            iw = jnp.asarray(winv)
+            R, Rinv = _scoped_base_factor(grid, window[iw][:, iw], scope_)
+            pw = jnp.asarray(wperm)
+            Rp = summa.cyclic_window_update(
+                Rp, R.astype(Rp.dtype)[pw][:, pw], (dest, dest, n, n),
+                grid.dx, ptile,
+            )
+            RIp = summa.cyclic_window_update(
+                RIp, Rinv.astype(RIp.dtype)[pw][:, pw], (dest, dest, n, n),
+                grid.dx, ptile,
+            )
+            return grid.pin(Rp), grid.pin(RIp)
         window = lax.slice(buf, (off, off), (off + n, off + n)).astype(bc_dtype)
         window = lax.with_sharding_constraint(window, grid.replicated_sharding())
         R, Rinv = _scoped_base_factor(grid, window, scope_)
-        Rp = lax.dynamic_update_slice(Rp, R.astype(Rp.dtype), (dest, dest))
-        RIp = lax.dynamic_update_slice(RIp, Rinv.astype(RIp.dtype), (dest, dest))
+        # i32 start indices: under x64 a Python-int index lowers as s64 and
+        # the SPMD partitioner compares it against its own s32 shard offsets
+        # (hlo-verifier rejection on the 0.4.x line)
+        d32 = jnp.int32(dest)
+        Rp = lax.dynamic_update_slice(Rp, R.astype(Rp.dtype), (d32, d32))
+        RIp = lax.dynamic_update_slice(RIp, Rinv.astype(RIp.dtype), (d32, d32))
         return grid.pin(Rp), grid.pin(RIp)
 
 
@@ -323,21 +394,21 @@ def _scoped_base_factor(
                 masking.symmetrize_from(w, "U"), uplo="U"
             )
             return (
-                lax.pcast(R, axes, to="varying"),
-                lax.pcast(Rinv, axes, to="varying"),
+                jax_compat.pcast(R, axes, to="varying"),
+                jax_compat.pcast(Rinv, axes, to="varying"),
             )
 
         def zeros():
             z = jnp.zeros_like(w)
             return (
-                lax.pcast(z, axes, to="varying"),
-                lax.pcast(z, axes, to="varying"),
+                jax_compat.pcast(z, axes, to="varying"),
+                jax_compat.pcast(z, axes, to="varying"),
             )
 
         R, Rinv = lax.cond(on, compute, zeros)
         return lax.psum(R, axes), lax.psum(Rinv, axes)
 
-    return jax.shard_map(
+    return jax_compat.shard_map(
         kernel,
         mesh=grid.mesh,
         in_specs=P(),
@@ -354,6 +425,7 @@ def _recurse(
     top: bool,
     Rp: jnp.ndarray,
     RIp: jnp.ndarray,
+    ptile: int = 0,
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One recursion window: input is the (off, off, node.n, node.n) window
     of `buf` (upper triangle valid — Schur windows from the uplo='U' syrk
@@ -374,7 +446,9 @@ def _recurse(
     the buffers through offset index maps (parallel/summa.py views).
     """
     if node.is_base:
-        Rp, RIp = _base_case_into(grid, buf, off, node.n, node.off, cfg, Rp, RIp)
+        Rp, RIp = _base_case_into(
+            grid, buf, off, node.n, node.off, cfg, Rp, RIp, ptile
+        )
         return buf, Rp, RIp
 
     left, right = node.top
@@ -388,12 +462,17 @@ def _recurse(
     # write consumed it, and XLA would restore single-assignment with a
     # full-buffer copy per spine level (measured: compile-time OOM at
     # n=49152 — 27.02G of 15.75G — from exactly this).
-    buf, Rp, RIp = _recurse(grid, buf, off, left, cfg, False, Rp, RIp)
+    buf, Rp, RIp = _recurse(grid, buf, off, left, cfg, False, Rp, RIp, ptile)
 
     # balanced schedules for the large explicit-mode windows (see
     # CholinvConfig.balance); summa falls back with a note where the
-    # balanced form does not apply
+    # balanced form does not apply.  With the persistent layout there is no
+    # per-window choice: the buffers ARE tile-cyclic, so every call states
+    # the storage contract (min_window economics vanished with the
+    # per-call shuffles)
     def _bal(win: int) -> str:
+        if ptile:
+            return "tile_cyclic_persistent"
         return (
             "tile_cyclic"
             if (
@@ -415,7 +494,7 @@ def _recurse(
             a_view=(d0, d0, n1, n1),
             b_view=(off, off + n1, n1, n2),
             out=Rp, out_off=(d0, d0 + n1),
-            balance=_bal(n1),
+            balance=_bal(n1), cyclic_tile=ptile,
         )
 
     # 3. Schur complement: A22' = A22 − R12ᵀR12 (cholinv.hpp:131-134, CI::tmu).
@@ -430,14 +509,14 @@ def _recurse(
             a_view=(d0, d0 + n1, n1, n2),
             c_view=(off + n1, off + n1, n2, n2),
             in_place=cfg.schur_in_place,
-            balance=_bal(n2),
+            balance=_bal(n2), cyclic_tile=ptile,
         )
 
     # 4. recurse on the trailing window (cholinv.hpp:139-142).  In-place
     # mode: S IS the updated buf (the Schur update landed in buf's trailing
     # window), so thread it onward as this node's buffer value.
     s_off = off + n1 if cfg.schur_in_place else 0
-    S, Rp, RIp = _recurse(grid, S, s_off, right, cfg, False, Rp, RIp)
+    S, Rp, RIp = _recurse(grid, S, s_off, right, cfg, False, Rp, RIp, ptile)
     if cfg.schur_in_place:
         buf = S
 
@@ -452,7 +531,7 @@ def _recurse(
                 mode=cfg.mode,
                 a_view=(d0, d0, n1, n1),
                 b_view=(d0, d0 + n1, n1, n2),
-                balance=_bal(n1),
+                balance=_bal(n1), cyclic_tile=ptile,
             )
             RIp = summa.trmm(
                 grid, RIp, T,
@@ -460,6 +539,11 @@ def _recurse(
                 mode=cfg.mode,
                 a_view=(right.off, right.off, n2, n2),
                 out=RIp, out_off=(d0, d0 + n1),
+                # the side-R completion trmm never takes the per-call
+                # balanced schedule (see CholinvConfig.balance), but under
+                # the persistent layout it MUST state the storage contract
+                balance="tile_cyclic_persistent" if ptile else "block",
+                cyclic_tile=ptile,
             )
     return buf, Rp, RIp
 
@@ -495,17 +579,38 @@ def factor(
     n = A.shape[0]
     if A.shape[0] != A.shape[1]:
         raise ValueError(f"cholinv needs a square matrix, got {A.shape}")
-    if cfg.balance not in ("block", "tile_cyclic"):
+    if cfg.balance not in ("block", "tile_cyclic", "tile_cyclic_persistent"):
         raise ValueError(f"unknown balance {cfg.balance!r}")
-    if cfg.balance == "tile_cyclic" and cfg.mode != "explicit":
+    if cfg.balance.startswith("tile_cyclic") and cfg.mode != "explicit":
         # the balanced schedules exist only in the explicit schedule; a
         # silent block fallback here would mis-attribute a whole
         # load-balance experiment
-        raise ValueError("balance='tile_cyclic' requires mode='explicit'")
+        raise ValueError(f"balance={cfg.balance!r} requires mode='explicit'")
     p = padded_dim(n, cfg.base_case_dim)
     # SPD-safe pad: diag(A, I) factors to diag(R, I) without cross-talk.
     Ap = grid.pin(pad_embed_identity(A, n, p))
     node = plan(p, cfg)
+
+    # persistent tile-cyclic layout: permute ONCE here (V = Ap[perm][:, perm]
+    # — a symmetric permutation, so SPD and the triangular-R contract of the
+    # unchanged elimination order survive), run the whole recursion in
+    # layout, un-permute R / Rinv once at exit.  Three lifetime shuffles
+    # priced as grid transposes (the entry shuffle here, the two exit
+    # shuffles below) replace the 2-3 shuffles PER trmm/syrk call of
+    # balance='tile_cyclic'.
+    ptile = 0
+    unperm = None
+    if cfg.balance == "tile_cyclic_persistent":
+        ptile = persistent_tile(grid, node, cfg)
+        if ptile:
+            perm, pinv = summa.tile_cyclic_perm(p, grid.dx, ptile)
+            pj = jnp.asarray(perm)
+            unperm = jnp.asarray(pinv)
+            Ap = grid.pin(Ap[pj][:, pj])
+            cbytes, ncoll = tracing.transpose_cost(grid, p, p, Ap.dtype)
+            tracing.emit(comm_bytes=3 * cbytes, collectives=3 * ncoll)
+        else:
+            tracing.note("cholinv::persistent_fallback")
 
     if out_buffers is not None:
         Rp, RIp = out_buffers
@@ -519,7 +624,22 @@ def factor(
                 "out_buffers requires complete_inv=True (the skipped "
                 "off-diagonal window would keep the previous contents)"
             )
-        _, R, Rinv = _recurse(grid, Ap, 0, node, cfg, True, Rp, RIp)
+        if ptile:
+            # out_buffers arrive in ORIGINAL order (factor returns
+            # un-permuted results); bring them into storage layout like Ap.
+            # Zeros are permutation-invariant and every live cell is
+            # rewritten, so the reuse contract holds — at the price of two
+            # extra shuffles, which is why the flagship out_buffers loop
+            # and the persistent layout are documented as an either/or
+            # (docs/DISTRIBUTED.md).
+            Rp = grid.pin(Rp[pj][:, pj])
+            RIp = grid.pin(RIp[pj][:, pj])
+            cbytes, ncoll = tracing.transpose_cost(grid, p, p, Rp.dtype)
+            tracing.emit(comm_bytes=2 * cbytes, collectives=2 * ncoll)
+        _, R, Rinv = _recurse(grid, Ap, 0, node, cfg, True, Rp, RIp, ptile)
+        if ptile:
+            R = R[unperm][:, unperm]
+            Rinv = Rinv[unperm][:, unperm]
         R, Rinv = grid.pin(R), grid.pin(Rinv)
         if p != n:
             R, Rinv = R[:n, :n], Rinv[:n, :n]
@@ -549,7 +669,10 @@ def factor(
     else:
         Rp = grid.pin(jnp.zeros((p, p), dtype=A.dtype))
         RIp = grid.pin(jnp.zeros((p, p), dtype=A.dtype))
-    _, R, Rinv = _recurse(grid, Ap, 0, node, cfg, True, Rp, RIp)
+    _, R, Rinv = _recurse(grid, Ap, 0, node, cfg, True, Rp, RIp, ptile)
+    if ptile:
+        R = R[unperm][:, unperm]
+        Rinv = Rinv[unperm][:, unperm]
     R, Rinv = grid.pin(R), grid.pin(Rinv)
     if p != n:
         R, Rinv = R[:n, :n], Rinv[:n, :n]

@@ -5,17 +5,24 @@ Two capabilities from the reference's inverse family, both finished here
 
 * ``rectri`` — recursive triangular inversion.  The reference's
   inverse::rectri wrote the nested-grid redistribution (`simulate`,
-  rectri.hpp:36-58) but `invert` only performs the deepest local trtri; the
-  cross-level assembly is a commented-out TODO sketch (rectri.hpp:70-99).
-  Here the full algorithm runs: for lower-triangular L
+  rectri.hpp:36-58) but `invert` only performs the deepest local trtri —
+  the cross-level assembly never landed (a commented-out sketch,
+  rectri.hpp:70-99).  DECISION, pinned by tests/test_inverse_trsm.py::
+  TestRectri::test_cross_level_assembly_pinned: this repo implements the
+  assembly in full, as windowed triangular products over one flat output
+  buffer — for lower-triangular L
 
       L⁻¹ = [[     L11⁻¹     ,   0  ]
              [−L22⁻¹·L21·L11⁻¹, L22⁻¹]]
 
-  as a trace-time recursion with SUMMA gemms for the off-diagonal block.
-  The reference's nested-grid Alltoall redistribution (shrinking subcube
-  meshes per level) has no TPU analog worth keeping: windows shrink but stay
-  on the full mesh, and XLA reshards slices as needed (SURVEY §7.3 item 5).
+  as a trace-time recursion whose merge trmms read/write views of the flat
+  buffers (`_rectri_into`) — and deliberately does NOT port the
+  reference's nested-grid Alltoall redistribution (shrinking subcube
+  meshes per level): that machinery has no TPU analog worth keeping, since
+  windows shrink but stay on the full mesh and XLA reshards slices as
+  needed (SURVEY §7.3 item 5).  What the sketch called "assembly" is here
+  exactly two trmms per merge plus one leaf trtri per base case, every
+  window written once, the never-written upper triangle exactly zero.
 
 * ``newton`` — Newton-Schulz iterative inversion.  The reference's version
   is bit-rotted and does not compile (newton.h:16-18 invalid ctor syntax;
@@ -177,8 +184,11 @@ def _rectri_into(
                     window, grid.replicated_sharding()
                 )
             inv = lapack.trtri(window, uplo="L")
+            # i32 starts: x64 Python-int indices lower as s64 and trip the
+            # 0.4.x SPMD partitioner's s32 shard-offset compare
+            o32 = jnp.int32(off)
             return grid.pin(
-                lax.dynamic_update_slice(out, inv.astype(out.dtype), (off, off))
+                lax.dynamic_update_slice(out, inv.astype(out.dtype), (o32, o32))
             )
 
     if size % cfg.base_case_dim == 0:
@@ -192,11 +202,13 @@ def _rectri_into(
     n2 = size - n1
     out = _rectri_into(grid, Tp, out, off, n1, cfg, stop_at)
     out = _rectri_into(grid, Tp, out, off + n1, n2, cfg, stop_at)
-    # B21 = −L22⁻¹ · L21 · L11⁻¹ (the TODO sketch at rectri.hpp:70-99),
-    # as two triangular products read/written through views of the flat
-    # buffers — the cholinv design (models/cholesky.py): no per-level
-    # jnp.block assembly, and both trmms skip the triangular operand's dead
-    # blocks (pallas single-device; segment-skipping explicit mode on a mesh)
+    # B21 = −L22⁻¹ · L21 · L11⁻¹ — the cross-level assembly the reference
+    # left as a commented-out sketch (rectri.hpp:70-99; decision documented
+    # in the module docstring) — as two triangular products read/written
+    # through views of the flat buffers, the cholinv design
+    # (models/cholesky.py): no per-level jnp.block assembly, and both trmms
+    # skip the triangular operand's dead blocks (pallas single-device;
+    # segment-skipping explicit mode on a mesh)
     bal = (
         "tile_cyclic"
         if (

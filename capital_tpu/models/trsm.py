@@ -210,7 +210,11 @@ def _solve_into(
         return lax.slice(X, (0, o), (X.shape[0], o + s))
 
     def _put(Xbuf: jnp.ndarray, val: jnp.ndarray, o: int) -> jnp.ndarray:
-        at = (o, 0) if side == "L" else (0, o)
+        # i32 starts: under x64 a Python-int index lowers as s64 and the
+        # 0.4.x SPMD partitioner compares it against its own s32 shard
+        # offsets (hlo-verifier rejection)
+        o32 = jnp.int32(o)
+        at = (o32, jnp.int32(0)) if side == "L" else (jnp.int32(0), o32)
         return lax.dynamic_update_slice(Xbuf, val.astype(Xbuf.dtype), at)
 
     if size <= cfg.base_case_dim:

@@ -80,9 +80,17 @@ def _lib() -> ctypes.CDLL | None:
         lib.cholinv_predict.argtypes = [
             i64, i64, i64, i64,
             ctypes.c_double, ctypes.c_double, ctypes.c_double,
-            i64, i64p, i64, i32p, i64, i64, i32, i64, dp,
+            i64, i64p, i64, i32p, i64, i64, i32, i64, i32,
+            ctypes.c_double, dp,
         ]
         lib.cholinv_predict.restype = i64
+        lib.capital_native_abi_version.restype = i32
+        if lib.capital_native_abi_version() != 3:
+            # stale cached .so from an older source tree (the cache is
+            # keyed by source hash, so this only trips on manual cache
+            # surgery) — fall back to the NumPy model rather than call a
+            # mismatched signature
+            return None
         _LIB = lib
         return _LIB
 
@@ -242,6 +250,8 @@ def cholinv_predict(
     split: int = 1,
     complete_inv: bool = True,
     num_chunks: int = 0,
+    balance: str | int = "block",
+    hbm_bytes_per_s: float = 8.2e11,
 ):
     """Predicted seconds per (policy, bc) config from the alpha-beta model;
     returns (seconds[num_pol, num_bc], (best_policy_idx, best_bc_idx)).
@@ -252,17 +262,32 @@ def cholinv_predict(
     reference's Ibcast/Iallreduce pipelining (summa.hpp:196-248): same
     bytes, chunk-fold more collective launches — only the alpha term moves
     (round-3 deliberately ignored chunks; a chunks-axis sweep would have
-    ranked every q identically)."""
+    ranked every q identically).
+
+    balance prices the schedule's COPY term (the data motion the cost
+    model used to ignore, mirrored from tracing's copy_bytes emissions at
+    hbm_bytes_per_s): 'block'/'tile_cyclic' walk the materializing
+    explicit schedule (take_triangle masks, window slices, whole-buffer
+    dynamic_update_slice round-trips per phase);
+    'tile_cyclic_persistent' prices the persistent layout — three
+    lifetime permutes on the comm side and band-sized residual motion on
+    the copy side.  On a single device the copy term is ~0 either way
+    (the d==1 explicit route rides the aliasing pallas kernels)."""
     lib = _lib()
     bcs = np.asarray(list(bc_dims), dtype=np.int64)
     pols = np.asarray([int(getattr(p, "value", p)) for p in policies], dtype=np.int32)
     out = np.empty((len(pols), len(bcs)), dtype=np.float64)
     dx, dy, c = grid_shape
+    bal = (
+        balance
+        if isinstance(balance, int)
+        else (1 if balance == "tile_cyclic_persistent" else 0)
+    )
     if lib is not None:
         best = lib.cholinv_predict(
             n, dx, dy, c, peak_flops, bw_bytes_per_s, alpha_s, itemsize,
             bcs, len(bcs), pols, len(pols), split, int(complete_inv),
-            num_chunks, out,
+            num_chunks, bal, hbm_bytes_per_s, out,
         )
         return out, (int(best) // len(bcs), int(best) % len(bcs))
     # NumPy fallback: same model (kept in lock-step with the C++ by
@@ -272,6 +297,7 @@ def cholinv_predict(
             out[ip, ib] = _predict_py(
                 n, dx, dy, c, peak_flops, bw_bytes_per_s, alpha_s, itemsize,
                 int(bc), int(pol), split, complete_inv, num_chunks,
+                bal, hbm_bytes_per_s,
             )
     best = int(np.argmin(out))
     return out, (best // len(bcs), best % len(bcs))
@@ -279,7 +305,7 @@ def cholinv_predict(
 
 def _predict_py(
     n, dx, dy, c, peak, bw, alpha, item, bc, pol, split, complete_inv,
-    num_chunks=0,
+    num_chunks=0, balance=0, hbm=8.2e11,
 ):
     def ring(b, p):
         return b * (p - 1) / p if p > 1 else 0.0
@@ -311,10 +337,23 @@ def _predict_py(
         return fl, comm, nc
 
     p = dx * dy * c
-    acc = [0.0, 0.0, 0.0]
+    acc = [0.0, 0.0, 0.0, 0.0]  # flops, comm_bytes, collectives, copy_bytes
 
     def add(t):
         acc[0] += t[0]; acc[1] += t[1]; acc[2] += t[2]
+
+    padded = min(bc, n)
+    while padded < n:
+        padded *= 2
+    P2 = float(padded) * padded  # whole-buffer dus round-trips move this
+
+    def copy(bytes_):
+        # schedule-inserted HBM motion, mirroring tracing's copy_bytes
+        # emissions (parallel/summa.py, 2.0 = read + write per moved
+        # array).  A single device rides the copy-free aliasing kernels —
+        # no term at all; that IS the d==1 explicit uplift.
+        if p > 1:
+            acc[3] += bytes_ * item
 
     def walk(w, top):
         if w <= bc:
@@ -331,19 +370,39 @@ def _predict_py(
                 elif pol >= 2:
                     acc[1] += 2.0 * allred(panel, p)
                     acc[2] += 2.0
+            # window extraction + the R/Rinv write-backs: two whole-buffer
+            # dus round-trips when materializing, band-sized under the
+            # persistent layout
+            copy(4.0 * w * w + (8.0 * w * w if balance else 4.0 * P2))
             return
         n1 = max(bc, w >> split)
         m2 = w - n1
         walk(n1, False)
+        # TRSM trmm: triangle mask + a_view + trans_a (3 x n1²), b_view
+        # (n1 x m2), result into Rp — whole-buffer dus vs band write-back
         add(gemm(n1, m2, n1))
+        copy(6.0 * n1 * n1 + 2.0 * n1 * m2
+             + (4.0 * n1 * m2 if balance else 2.0 * P2))
+        # Schur syrk: operand .T + a_view (2 x n1 m2), symmetrize (4 m2²)
+        # + c_view (2 m2²), update back into buf
         add(gemm(m2, m2, n1))
+        copy(4.0 * n1 * m2 + 6.0 * m2 * m2
+             + (4.0 * m2 * m2 if balance else 2.0 * P2))
         walk(m2, False)
         if complete_inv or not top:
+            # completion trmms: T (no out), then side-R into RIp
             add(gemm(n1, m2, n1))
+            copy(4.0 * n1 * n1 + 2.0 * n1 * m2)
             add(gemm(n1, m2, m2))
+            copy(4.0 * m2 * m2
+                 + (4.0 * n1 * m2 if balance else 2.0 * P2))
 
-    padded = min(bc, n)
-    while padded < n:
-        padded *= 2
+    if balance and p > 1:
+        # persistent layout: three lifetime permutes (A in, R and Rinv
+        # out), priced like grid transposes — per-device block exchange
+        acc[1] += 3.0 * P2 / (dx * dy) * item
+        acc[2] += 3.0
     walk(padded, True)
-    return acc[0] / peak + acc[1] / bw + acc[2] * alpha
+    return (
+        acc[0] / peak + acc[1] / bw + acc[2] * alpha + acc[3] / p / hbm
+    )

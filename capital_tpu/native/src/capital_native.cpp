@@ -154,7 +154,7 @@ void unpack_lower(const double* packed, double* A, int64_t n) {
 // the replication collective (replicate_cost).  Units: seconds, via
 // (peak_flops, bw_Bps, alpha_s).
 
-struct Cost { double flops, comm, ncoll; };
+struct Cost { double flops, comm, ncoll, copy; };
 
 static inline double ring_bytes(double bytes, int64_t p) {
   return p > 1 ? bytes * (double)(p - 1) / (double)p : 0.0;
@@ -201,10 +201,23 @@ static void add(Cost* acc, Cost c) {
   acc->flops += c.flops; acc->comm += c.comm; acc->ncoll += c.ncoll;
 }
 
+// Schedule-inserted HBM motion in ELEMENTS (caller multiplies by item),
+// mirroring tracing's copy_bytes emissions (parallel/summa.py; 2.0 = one
+// read + one write of the moved array).  A single device rides the
+// copy-free aliasing kernels: no copy term at all.
+static inline void add_copy(Cost* acc, int64_t p, int64_t item, double elems) {
+  if (p > 1) acc->copy += elems * (double)item;
+}
+
 // Recursion over the window; mirrors plan()/_recurse() phase structure.
+// `balance`: 0 = materializing block schedule (take_triangle masks, window
+// slices, whole-buffer dynamic_update_slice round-trips), 1 = persistent
+// tile-cyclic layout (band-sized residual motion; the lifetime permutes
+// are priced by the caller on the comm side).
 static void cholinv_walk(int64_t w, int64_t bc, int64_t split, int64_t dx,
                          int64_t dy, int64_t c, int64_t item, int32_t policy,
-                         int32_t complete_inv, int64_t num_chunks, Cost* acc) {
+                         int32_t complete_inv, int64_t num_chunks,
+                         int32_t balance, double P2, Cost* acc) {
   const int64_t p = dx * dy * c;
   if (w <= bc) {
     // base case (models/cholesky.py:_base_case_into): the panel is
@@ -225,19 +238,40 @@ static void cholinv_walk(int64_t w, int64_t bc, int64_t split, int64_t dx,
         acc->ncoll += 2.0;
       }
     }
+    // window extraction + the R/Rinv write-backs: two whole-buffer dus
+    // round-trips when materializing, band-sized under the persistent layout
+    add_copy(acc, p, item,
+             4.0 * (double)w * w
+                 + (balance ? 8.0 * (double)w * w : 4.0 * P2));
     return;
   }
   int64_t n1 = std::max(bc, w >> split);
   int64_t m2 = w - n1;
-  cholinv_walk(n1, bc, split, dx, dy, c, item, policy, 1, num_chunks, acc);
-  // TRSM phase: R12 = R11^-T A12 (trmm, triangular operand halves the flops)
+  cholinv_walk(n1, bc, split, dx, dy, c, item, policy, 1, num_chunks, balance,
+               P2, acc);
+  // TRSM phase: R12 = R11^-T A12 (trmm, triangular operand halves the flops);
+  // copies: triangle mask + a_view + trans_a (3 x n1^2), b_view (n1 x m2),
+  // then the result lands in Rp
   add(acc, gemm_cost(n1, m2, n1, dx, dy, c, item, 0.5, num_chunks));
-  // Schur: A22 -= R12^T R12 (syrk: symmetric output halves useful flops)
+  add_copy(acc, p, item,
+           6.0 * (double)n1 * n1 + 2.0 * (double)n1 * m2
+               + (balance ? 4.0 * (double)n1 * m2 : 2.0 * P2));
+  // Schur: A22 -= R12^T R12 (syrk: symmetric output halves useful flops);
+  // copies: operand .T + a_view (2 x n1 m2), symmetrize (4 m2^2) + c_view
+  // (2 m2^2), update back into buf
   add(acc, gemm_cost(m2, m2, n1, dx, dy, c, item, 0.5, num_chunks));
-  cholinv_walk(m2, bc, split, dx, dy, c, item, policy, 1, num_chunks, acc);
+  add_copy(acc, p, item,
+           4.0 * (double)n1 * m2 + 6.0 * (double)m2 * m2
+               + (balance ? 4.0 * (double)m2 * m2 : 2.0 * P2));
+  cholinv_walk(m2, bc, split, dx, dy, c, item, policy, 1, num_chunks, balance,
+               P2, acc);
   if (complete_inv) {  // inverse completion: two trmms
     add(acc, gemm_cost(n1, m2, n1, dx, dy, c, item, 0.5, num_chunks));
+    add_copy(acc, p, item, 4.0 * (double)n1 * n1 + 2.0 * (double)n1 * m2);
     add(acc, gemm_cost(n1, m2, m2, dx, dy, c, item, 0.5, num_chunks));
+    add_copy(acc, p, item,
+             4.0 * (double)m2 * m2
+                 + (balance ? 4.0 * (double)n1 * m2 : 2.0 * P2));
   }
 }
 
@@ -248,17 +282,27 @@ int64_t cholinv_predict(int64_t n, int64_t dx, int64_t dy, int64_t c,
                         int64_t itemsize, const int64_t* bcs, int64_t num_bc,
                         const int32_t* policies, int64_t num_pol,
                         int64_t split, int32_t complete_inv,
-                        int64_t num_chunks, double* out_seconds) {
+                        int64_t num_chunks, int32_t balance, double hbm_Bps,
+                        double* out_seconds) {
+  const int64_t p = dx * dy * c;
   int64_t best = 0;
   for (int64_t ip = 0; ip < num_pol; ++ip) {
     for (int64_t ib = 0; ib < num_bc; ++ib) {
       // pad n to a multiple chain of bc like padded_dim()
       int64_t bc = bcs[ib], padded = std::min(bc, n);
       while (padded < n) padded *= 2;
-      Cost acc{0, 0, 0};
+      double P2 = (double)padded * padded;
+      Cost acc{0, 0, 0, 0};
+      if (balance && p > 1) {
+        // persistent layout: three lifetime permutes (A in, R and Rinv
+        // out), priced like grid transposes (per-device block exchange)
+        acc.comm += 3.0 * P2 / (double)(dx * dy) * itemsize;
+        acc.ncoll += 3.0;
+      }
       cholinv_walk(padded, bc, split, dx, dy, c, itemsize, policies[ip],
-                   complete_inv, num_chunks, &acc);
-      double s = acc.flops / peak_flops + acc.comm / bw_Bps + acc.ncoll * alpha_s;
+                   complete_inv, num_chunks, balance, P2, &acc);
+      double s = acc.flops / peak_flops + acc.comm / bw_Bps +
+                 acc.ncoll * alpha_s + acc.copy / (double)p / hbm_Bps;
       out_seconds[ip * num_bc + ib] = s;
       if (s < out_seconds[best]) best = ip * num_bc + ib;
     }
@@ -266,6 +310,6 @@ int64_t cholinv_predict(int64_t n, int64_t dx, int64_t dy, int64_t c,
   return best;
 }
 
-int32_t capital_native_abi_version(void) { return 2; }
+int32_t capital_native_abi_version(void) { return 3; }
 
 }  // extern "C"

@@ -210,10 +210,20 @@ def audit(fn: Callable, *args, jit_kwargs: Optional[dict] = None) -> ProgramAudi
 
 def trace_model(fn: Callable, *args) -> tracing.Recorder:
     """Capture the analytic Recorder model for one program by tracing only
-    (jax.eval_shape — phase emits fire at trace time, nothing executes)."""
+    (jax.eval_shape — phase emits fire at trace time, nothing executes).
+
+    The trace runs through a FRESH wrapper function each call: jax caches
+    traces by function identity, so re-tracing a function that was already
+    traced (by an earlier trace_model, or by audit()'s jit/lower on the
+    same object) would hit the cache, skip the Python bodies, and return
+    an empty Recorder — model totals of 0 instead of the schedule's."""
     rec = tracing.Recorder()
+
+    def _fresh(*a):
+        return fn(*a)
+
     with rec:
-        jax.eval_shape(fn, *args)
+        jax.eval_shape(_fresh, *args)
     return rec
 
 

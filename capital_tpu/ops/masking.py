@@ -36,6 +36,38 @@ def take_triangle(A: jnp.ndarray, uplo: str) -> jnp.ndarray:
     raise ValueError(f"uplo must be 'U' or 'L', got {uplo!r}")
 
 
+def cyclic_index(n: int, d: int, tile: int) -> jnp.ndarray:
+    """orig[i] = ORIGINAL row/col index stored at position i of a tile-cyclic
+    layout over d devices (parallel/summa.tile_cyclic_perm): storage is d
+    contiguous device chunks, chunk s holding original tiles ≡ s (mod d) in
+    ascending order.  Pure iota arithmetic — shard-transparent like the
+    other masks (the per-shard slice of the index vector is local)."""
+    if n % (d * tile):
+        raise ValueError(f"cyclic_index: {d} devices x tile {tile} must tile {n}")
+    i = jnp.arange(n)
+    chunk, j = i // (n // d), i % (n // d)
+    return ((j // tile) * d + chunk) * tile + (j % tile)
+
+
+def take_triangle_cyclic(
+    A: jnp.ndarray, uplo: str, d: int, tile: int, strict: bool = False
+) -> jnp.ndarray:
+    """take_triangle for a matrix whose BOTH axes are stored tile-cyclically
+    (the persistent layout V = X[perm][:, perm]): the triangle lives at
+    ORIGINAL indices, so the mask compares the cyclic index maps instead of
+    raw positions.  Elementwise like every other mask here — fuses.
+    strict=True drops the diagonal (the symmetrize helper's second term)."""
+    r = cyclic_index(A.shape[0], d, tile)
+    c = cyclic_index(A.shape[1], d, tile)
+    if uplo == "U":
+        m = r[:, None] < c[None, :] if strict else r[:, None] <= c[None, :]
+    elif uplo == "L":
+        m = r[:, None] > c[None, :] if strict else r[:, None] >= c[None, :]
+    else:
+        raise ValueError(f"uplo must be 'U' or 'L', got {uplo!r}")
+    return A * m.astype(A.dtype)
+
+
 def with_unit_diagonal(A: jnp.ndarray) -> jnp.ndarray:
     """Force ones on the diagonal (trmm/trsm 'Diag::AblasUnit' support,
     reference blas::Diag, engine.h:23-52)."""

@@ -72,6 +72,8 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from capital_tpu.utils import jax_compat
+
 # Platform resolution for interpret/tile decisions.  The process default
 # backend is the wrong thing to key off in a mixed environment: a CPU mesh in
 # a TPU-backed process (the driver's dryrun_multichip with
@@ -511,7 +513,8 @@ def sched_matmul(
             transcendentals=0,
         ),
         interpret=interpret,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=jax_compat.pallas_compiler_params(
+            pltpu,
             dimension_semantics=("arbitrary", "arbitrary"),
             vmem_limit_bytes=vmem_limit,
         ),
@@ -916,7 +919,8 @@ def tri_matmul(
                 memory_space=pltpu.VMEM,
             ),
             input_output_aliases=aliases,
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=jax_compat.pallas_compiler_params(
+                pltpu,
                 dimension_semantics=("parallel", "parallel", "arbitrary"),
                 vmem_limit_bytes=vmem_limit,
             ),
@@ -1007,7 +1011,8 @@ def tri_matmul(
             cost_estimate=common["cost_estimate"],
             input_output_aliases=aliases,
             interpret=interpret,
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=jax_compat.pallas_compiler_params(
+                pltpu,
                 dimension_semantics=("arbitrary", "arbitrary"),
                 vmem_limit_bytes=vmem_limit,
             ),
@@ -1113,7 +1118,8 @@ def tri_matmul(
             cost_estimate=common["cost_estimate"],
             input_output_aliases=aliases,
             interpret=interpret,
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=jax_compat.pallas_compiler_params(
+                pltpu,
                 dimension_semantics=("parallel", "arbitrary"),
                 vmem_limit_bytes=vmem_limit,
             ),

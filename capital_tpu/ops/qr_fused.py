@@ -49,6 +49,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from capital_tpu.utils import jax_compat
 from capital_tpu.ops.pallas_tpu import (
     _device_budget,
     _interpret_default,
@@ -85,7 +86,7 @@ def _out_struct(shape, dtype, *operands):
     set is empty and this is a plain ShapeDtypeStruct."""
     vma: frozenset = frozenset()
     for r in operands:
-        vma |= jax.typeof(r).vma
+        vma |= jax_compat.vma_of(r)
     if vma:
         return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
     return jax.ShapeDtypeStruct(shape, dtype)
@@ -185,7 +186,8 @@ def gram_blocked(
         ],
         out_specs=pl.BlockSpec((n, n), lambda i: (0, 0), memory_space=pltpu.VMEM),
         out_shape=_out_struct((n, n), acc, A),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=jax_compat.pallas_compiler_params(
+            pltpu,
             dimension_semantics=("arbitrary",),
             vmem_limit_bytes=_device_budget()[1],
         ),
@@ -270,7 +272,8 @@ def scale_gram(
             _out_struct((m, n), A.dtype, A, Rinv),
             _out_struct((n, n), acc, A, Rinv),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=jax_compat.pallas_compiler_params(
+            pltpu,
             dimension_semantics=("arbitrary",),
             vmem_limit_bytes=_device_budget()[1],
         ),
@@ -331,7 +334,8 @@ def scale_blocked(
         ],
         out_specs=pl.BlockSpec((bm, n), lambda i: (i, 0), memory_space=pltpu.VMEM),
         out_shape=_out_struct((m, n), A.dtype, A, Rinv),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=jax_compat.pallas_compiler_params(
+            pltpu,
             dimension_semantics=("arbitrary",),
             vmem_limit_bytes=_device_budget()[1],
         ),

@@ -55,7 +55,7 @@ from jax.sharding import PartitionSpec as P
 
 from capital_tpu.ops import masking, pallas_tpu
 from capital_tpu.parallel.topology import Grid
-from capital_tpu.utils import tracing
+from capital_tpu.utils import jax_compat, tracing
 
 
 @dataclasses.dataclass(frozen=True)
@@ -131,6 +131,63 @@ def tile_cyclic_perm(m: int, d: int, tile: int):
     inv = np.empty_like(perm)
     inv[perm] = np.arange(m)
     return perm, inv
+
+
+def cyclic_window(V: jnp.ndarray, view, d: int, tile: int) -> jnp.ndarray:
+    """Extract the LOGICAL window ``view = (r0, c0, rows, cols)`` of a buffer
+    stored in the PERSISTENT symmetric tile-cyclic layout V = X[perm][:, perm]
+    (perm = tile_cyclic_perm(p, d, tile)) — without un-permuting.
+
+    The layout is d contiguous device chunks per axis, chunk s holding
+    original tiles ≡ s (mod d) ascending; a window aligned to d*tile is a
+    CONTIGUOUS slice of every chunk, so extraction is reshape + static slice
+    (shard-local under P('x','y'): the sliced axes are the unsharded
+    within-chunk ones).  The result is itself in window-local tile-cyclic
+    layout on both axes, and that local perm depends only on (extent, d,
+    tile) — never on the offset — which is what lets every aligned window of
+    the recursion interoperate (models/cholesky.py threads whole factors
+    through this)."""
+    r0, c0, rows, cols = view
+    p, pc = V.shape
+    g = d * tile
+    if r0 % g or c0 % g or rows % g or cols % g or p % g or pc % g:
+        raise ValueError(
+            f"cyclic_window: view {view} of {(p, pc)} must align to "
+            f"d*tile = {g}"
+        )
+    W = V.reshape(d, p // g, tile, pc)[:, r0 // g : (r0 + rows) // g]
+    W = W.reshape(rows, pc)
+    W = W.reshape(rows, d, pc // g, tile)[:, :, c0 // g : (c0 + cols) // g]
+    return W.reshape(rows, cols)
+
+
+def cyclic_window_update(
+    V: jnp.ndarray, W: jnp.ndarray, view, d: int, tile: int
+) -> jnp.ndarray:
+    """Write a window-local tile-cyclic result W back into the window `view`
+    of the persistent-layout buffer V (inverse of cyclic_window; V is
+    consumed).  Touches only the window's chunk slices — the read-modify-
+    write is band-sized, not buffer-sized (the whole-buffer dus round-trip
+    this layout exists to remove)."""
+    r0, c0, rows, cols = view
+    p, pc = V.shape
+    g = d * tile
+    if r0 % g or c0 % g or rows % g or cols % g or p % g or pc % g:
+        raise ValueError(
+            f"cyclic_window_update: view {view} of {(p, pc)} must align to "
+            f"d*tile = {g}"
+        )
+    a, b = r0 // g, (r0 + rows) // g
+    e, f = c0 // g, (c0 + cols) // g
+    V4 = V.reshape(d, p // g, tile, pc)
+    band = V4[:, a:b].reshape(rows, pc)
+    band = (
+        band.reshape(rows, d, pc // g, tile)
+        .at[:, :, e:f]
+        .set(W.astype(V.dtype).reshape(rows, d, f - e, tile))
+        .reshape(rows, pc)
+    )
+    return V4.at[:, a:b].set(band.reshape(d, b - a, tile, pc)).reshape(p, pc)
 
 
 def _pick_cyclic_tile(grid: Grid, dim: int, override: int) -> int:
@@ -397,6 +454,74 @@ def _sched_pairs(grid, M, K, N, a_uplo, b_uplo):
     )
 
 
+def _sched_pairs_cyclic(grid, M, K, N, a_uplo, b_uplo, t):
+    """_sched_pairs for the PERSISTENT tile-cyclic layout
+    (balance='tile_cyclic_persistent'): the triangular operand's cyclic axis
+    (rows for side L / cols for side R) AND the contraction axis are both
+    stored in tile_cyclic_perm order, so liveness is evaluated at ORIGINAL
+    tile indices — local storage tile j on device i is original tile j*d+i,
+    and gathered storage K-tile kt (contributed by device kt // (K/(d*t)),
+    slot kt mod that) is original K-tile (kt % nkc)*d + kt // nkc.  The
+    tile size is pinned to the layout's t on the cyclic axes; the dense
+    free axis picks the usual 512/256/128.  Under a cyclic K the interval
+    segment predicates of the block schedule are simply WRONG (dead
+    K-ranges are no longer contiguous), so there is no segment-skipping
+    middle ground: callers fall back to a dense contraction on None."""
+    import numpy as _np
+
+    d = grid.dx
+    a_side = a_uplo is not None
+    uplo = a_uplo if a_side else b_uplo
+    loc = M // d if a_side else N // d  # triangular/cyclic axis, local
+    dense = N // d if a_side else M // d  # dense free axis, local
+    if loc % t or K % (d * t):
+        return None
+    bfree = next((b for b in (512, 256, 128) if dense % b == 0), dense)
+    ntl, nkc = loc // t, K // (d * t)
+    nkt = d * nkc
+    per_dev = []
+    for xi in range(d):
+        pairs = []
+        for j in range(ntl):
+            g = j * d + xi  # original tile on the cyclic output axis
+            for kt in range(nkt):
+                gk = (kt % nkc) * d + kt // nkc  # original K tile
+                if a_side:
+                    # A (M, K) triangular: U keeps cols >= rows
+                    live = gk >= g if uplo == "U" else gk <= g
+                else:
+                    # B (K, N) triangular: U keeps rows <= cols
+                    live = gk <= g if uplo == "U" else gk >= g
+                if live:
+                    pairs.append((j, kt))
+        if not pairs:
+            return None
+        per_dev.append(pairs)
+    L = max(len(p) for p in per_dev)
+    TO = _np.zeros((d, L), _np.int32)
+    KO = _np.zeros((d, L), _np.int32)
+    FI = _np.zeros((d, L), _np.int32)
+    LA = _np.zeros((d, L), _np.int32)
+    for xi, pairs in enumerate(per_dev):
+        for idx, (j, k) in enumerate(pairs):
+            TO[xi, idx], KO[xi, idx] = j, k
+            FI[xi, idx] = 1 if idx == 0 or pairs[idx - 1][0] != j else 0
+            LA[xi, idx] = (
+                1 if idx == len(pairs) - 1 or pairs[idx + 1][0] != j else 0
+            )
+        TO[xi, len(pairs):], KO[xi, len(pairs):] = pairs[-1]
+    # padded lockstep like _sched_pairs; the cyclic layout makes per-device
+    # live counts near-equal, so L ~ the volumetric mean — max == mean is
+    # the whole point of the persistent layout
+    frac = L / float(ntl * nkt)
+    blocks = (t, bfree, t) if a_side else (bfree, t, t)
+    return (
+        (jnp.asarray(TO), jnp.asarray(KO), jnp.asarray(FI), jnp.asarray(LA)),
+        frac,
+        blocks,
+    )
+
+
 def _shard_sched_gate(grid, M, K, N, a_uplo, b_uplo, out_uplo,
                       cyclic_rows=0, cyclic_out=0):
     """Does the d > 1 explicit schedule route through the runtime-scheduled
@@ -652,10 +777,10 @@ def _explicit_matmul(
             # union of the operands' axes
             vma: set = set()
             for r in operands:
-                vma |= set(jax.typeof(r).vma)
+                vma |= jax_compat.vma_of(r)
             zeros = jnp.zeros(shape or (mb, nb), dtype=acc_dtype)
             if vma:
-                zeros = lax.pcast(zeros, tuple(sorted(vma)), to="varying")
+                zeros = jax_compat.pcast(zeros, tuple(sorted(vma)), to="varying")
             return lax.cond(live, mm, lambda: zeros)
 
         def matmul_term(live, a_op, b_op):
@@ -811,7 +936,7 @@ def _explicit_matmul(
                 off += wd
         return pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces, axis=1)
 
-    return jax.shard_map(
+    return jax_compat.shard_map(
         kernel,
         mesh=grid.mesh,
         in_specs=(P("x", "y"), P("x", "y")),
@@ -836,6 +961,7 @@ def _matmul(
     out_uplo: str | None = None,
     cyclic_rows: int = 0,
     cyclic_out: int = 0,
+    sched_override=None,
 ) -> jnp.ndarray:
     """The uplo flags describe triangular structure of the (already masked)
     operands/result; only mode='explicit' exploits them (dead K-segments /
@@ -843,7 +969,9 @@ def _matmul(
     (`flops`) stays dense; the executed views carry the skipping:
     flops_vol (mean over devices) and flops_max (the critical-path device,
     which with block distribution still runs up to the full contraction —
-    see tri_fractions)."""
+    see tri_fractions).  sched_override hands in an externally built
+    per-device tile schedule (_sched_pairs_cyclic — the persistent layout,
+    whose liveness the gates here cannot derive from shapes alone)."""
     # cost-model attribution (no-op without an active tracing.Recorder)
     M, K, N = A.shape[0], A.shape[1], B.shape[1]
     flops, comm, ncoll = tracing.gemm_cost(
@@ -851,7 +979,10 @@ def _matmul(
     )
     if mode == "explicit":
         sched = None
-        if _shard_kernels_gate(
+        if sched_override is not None:
+            sched = sched_override
+            mean_f = max_f = sched[1]
+        elif _shard_kernels_gate(
             grid, M, K, N, a_uplo, b_uplo, out_uplo, cyclic_rows, cyclic_out
         ):
             # per-shard live-tile kernels: same /2 executed convention as
@@ -915,6 +1046,159 @@ def _take_view(X, view):
     return pallas_tpu._window(X, view)
 
 
+def _i32_off(off):
+    # i32 start indices for dynamic_update_slice on sharded buffers: under
+    # x64 a Python-int index lowers as s64 and the 0.4.x SPMD partitioner
+    # compares it against its own s32 shard offsets (hlo-verifier rejection)
+    return tuple(jnp.int32(o) for o in off)
+
+
+def _persistent_params(grid: Grid, mode: str, cyclic_tile: int, who: str):
+    """Validate a balance='tile_cyclic_persistent' call.  Unlike
+    'tile_cyclic' (a schedule preference with a benign block fallback),
+    'persistent' is a STORAGE contract: the caller asserts the passed
+    buffers are in the symmetric tile-cyclic layout, so any silent fallback
+    would read them as block-ordered and compute garbage — violations
+    raise."""
+    d = grid.dx
+    q = max(1, grid.num_chunks)
+    if (
+        mode != "explicit" or grid.c != 1 or grid.dy != d or d < 2
+        or q != 1 or cyclic_tile < 1
+    ):
+        raise ValueError(
+            f"{who}: balance='tile_cyclic_persistent' requires "
+            "mode='explicit' on an unchunked c==1 square face with d>1 and "
+            f"an explicit cyclic_tile >= 1 (the layout's tile); got "
+            f"mode={mode!r}, grid {grid.dx}x{grid.dy}x{grid.c}, chunks={q}, "
+            f"cyclic_tile={cyclic_tile}"
+        )
+    return d, cyclic_tile
+
+
+def _copy_bytes_of(*terms) -> float:
+    """Sum of (factor, array) HBM-copy prices: factor counts reads+writes
+    of the moved array (2.0 = one read + one write)."""
+    return float(
+        sum(f * a.size * jnp.dtype(a.dtype).itemsize for f, a in terms)
+    )
+
+
+def _trmm_persistent(
+    grid, A, B, args, mode, a_view, b_view, out, out_off, cyclic_tile
+):
+    """trmm where EVERY passed buffer is stored in the persistent symmetric
+    tile-cyclic layout V = X[perm][:, perm] (models/cholesky.py's
+    balance='tile_cyclic_persistent'): window reads are chunk-local
+    reshapes (cyclic_window), the triangle mask tests original indices
+    (masking.take_triangle_cyclic), liveness is scheduled per original
+    tile (_sched_pairs_cyclic -> pallas_tpu.sched_matmul with the layout's
+    tile), and the product emerges ALREADY in layout — zero per-call row
+    shuffles, where balance='tile_cyclic' pays two per call."""
+    d, t = _persistent_params(grid, mode, cyclic_tile, "trmm")
+    if args.diag == "U":
+        raise ValueError(
+            "tile_cyclic_persistent trmm does not support diag='U'"
+        )
+    Aw = cyclic_window(A, a_view, d, t) if a_view is not None else A
+    Bw = cyclic_window(B, b_view, d, t) if b_view is not None else B
+    T = masking.take_triangle_cyclic(Aw, args.uplo, d, t)
+    Top = T.T if args.trans_a else T
+    eff_uplo = (
+        args.uplo if not args.trans_a else ("L" if args.uplo == "U" else "U")
+    )
+    # residual data motion: the windows/mask/transpose still materialize,
+    # but WINDOW-sized and shuffle-free — price it so the ledger separates
+    # this residue from the full-buffer copies the layout removed
+    cb = _copy_bytes_of((2.0, Aw))  # triangle mask
+    if a_view is not None:
+        cb += _copy_bytes_of((2.0, Aw))
+    if args.trans_a:
+        cb += _copy_bytes_of((2.0, Aw))
+    if b_view is not None:
+        cb += _copy_bytes_of((2.0, Bw))
+    if args.side == "L":
+        sched = _sched_pairs_cyclic(
+            grid, Top.shape[0], Top.shape[1], Bw.shape[1], eff_uplo, None, t
+        )
+        if sched is None:
+            tracing.note("trmm::persistent_dense")
+            res = _matmul(grid, Top, Bw, mode, args.precision)
+        else:
+            tracing.note("trmm::persistent_cyclic")
+            res = _matmul(
+                grid, Top, Bw, mode, args.precision, a_uplo=eff_uplo,
+                sched_override=sched,
+            )
+    elif args.side == "R":
+        sched = _sched_pairs_cyclic(
+            grid, Bw.shape[0], Bw.shape[1], Top.shape[1], None, eff_uplo, t
+        )
+        if sched is None:
+            tracing.note("trmm::persistent_dense")
+            res = _matmul(grid, Bw, Top, mode, args.precision)
+        else:
+            tracing.note("trmm::persistent_cyclic")
+            res = _matmul(
+                grid, Bw, Top, mode, args.precision, b_uplo=eff_uplo,
+                sched_override=sched,
+            )
+    else:
+        raise ValueError(f"side must be 'L' or 'R', got {args.side!r}")
+    if args.alpha != 1.0:
+        res = args.alpha * res
+    if out is not None:
+        # band-sized read-modify-write, not the whole-buffer dus round-trip
+        cb += _copy_bytes_of((4.0, res))
+        tracing.emit(copy_bytes=cb / grid.num_devices)
+        view = (out_off[0], out_off[1], res.shape[0], res.shape[1])
+        return grid.pin(cyclic_window_update(out, res, view, d, t))
+    tracing.emit(copy_bytes=cb / grid.num_devices)
+    return grid.pin(res)
+
+
+def _syrk_persistent(grid, A, C, args, mode, a_view, c_view, in_place,
+                     cyclic_tile):
+    """syrk under the persistent layout: the cyclic_out schedule of
+    _explicit_matmul IS window-local cyclic liveness (original tile pair
+    (ti*d+xi, tj*d+yi)), so the balanced contraction runs unchanged — what
+    disappears are the three per-call shuffles balance='tile_cyclic' pays
+    (A's free axis in, both output axes out): operands arrive and the
+    update leaves in layout.  Symmetrization is cyclic-aware — the live
+    triangle sits at ORIGINAL indices (masking.take_triangle_cyclic), and
+    transposing a both-axes-same-perm matrix stays in layout."""
+    d, t = _persistent_params(grid, mode, cyclic_tile, "syrk")
+    Aw = cyclic_window(A, a_view, d, t) if a_view is not None else A
+    cb = _copy_bytes_of((2.0, Aw))  # the .T below
+    if a_view is not None:
+        cb += _copy_bytes_of((2.0, Aw))
+    Aop = (Aw.T, Aw) if args.trans else (Aw, Aw.T)
+    D = _matmul(
+        grid, Aop[0], Aop[1], mode, args.precision, out_uplo=args.uplo,
+        cyclic_out=t,
+    )
+    tracing.note("syrk::persistent_cyclic")
+    live = masking.take_triangle_cyclic(D, args.uplo, d, t)
+    strict = masking.take_triangle_cyclic(D, args.uplo, d, t, strict=True)
+    out = live + transpose(grid, strict)
+    cb += _copy_bytes_of((4.0, D))  # the two mask materializations
+    if args.alpha != 1.0:
+        out = args.alpha * out
+    if args.beta != 0.0:
+        Cw = cyclic_window(C, c_view, d, t) if c_view is not None else C
+        out = out + args.beta * grid.pin(Cw)
+        if c_view is not None:
+            cb += _copy_bytes_of((2.0, Cw))
+    if in_place:
+        r0, c0 = (c_view[0], c_view[1]) if c_view is not None else (0, 0)
+        cb += _copy_bytes_of((4.0, out))
+        tracing.emit(copy_bytes=cb / grid.num_devices)
+        view = (r0, c0, out.shape[0], out.shape[1])
+        return grid.pin(cyclic_window_update(C, out, view, d, t))
+    tracing.emit(copy_bytes=cb / grid.num_devices)
+    return grid.pin(out)
+
+
 @pallas_tpu.scoped_by_grid
 def trmm(
     grid: Grid,
@@ -947,6 +1231,18 @@ def trmm(
     Unsupported combinations fall back to the block schedule with a
     tracing note.
 
+    balance='tile_cyclic_persistent' (explicit mode, both sides): the
+    caller asserts EVERY passed buffer — operands, `out`, and the views
+    into them — is already stored in the symmetric tile-cyclic layout
+    V = X[perm][:, perm] with tile `cyclic_tile` (models/cholesky.py
+    permutes once per matrix lifetime).  Window reads become chunk-local
+    reshapes (cyclic_window), liveness is scheduled per original tile, and
+    the product emerges in layout: the two per-call shuffles of
+    'tile_cyclic' and the whole-buffer dus round-trip disappear.  This is
+    a storage contract, not a preference — unsupported topologies raise
+    instead of falling back (a block-ordered read of a cyclic buffer would
+    be garbage).
+
     The triangular operand is dense + masked; the mask fuses into the matmul
     (no packed storage — SURVEY §7.1).  mode='pallas' on a single-device
     grid skips the dead blocks on the MXU instead (ops/pallas_tpu.py).
@@ -961,7 +1257,12 @@ def trmm(
     in models/cholesky.py is)."""
     a_dims = (a_view[2], a_view[3]) if a_view is not None else A.shape
     b_dims = (b_view[2], b_view[3]) if b_view is not None else B.shape
-    if mode == "pallas" and grid.num_devices == 1 and args.diag != "U":
+    if (
+        mode in ("pallas", "explicit")
+        and grid.num_devices == 1
+        and args.diag != "U"
+        and balance != "tile_cyclic_persistent"
+    ):
         if balance == "tile_cyclic":
             # single-device kernels skip dead tiles directly; the balanced
             # schedule does not apply — honor the fallback-with-a-note
@@ -970,7 +1271,22 @@ def trmm(
         flops, comm, ncoll = tracing.gemm_cost(
             grid, b_dims[0], b_dims[1], a_dims[0], jnp.result_type(A, B)
         )
-        tracing.emit(flops=flops / 2, comm_bytes=comm, collectives=ncoll)
+        if mode == "explicit":
+            # copy-free d==1 route (the single-chip constant of the explicit
+            # path, DISTRIBUTED.md): at one device every liveness predicate
+            # is static, so the schedule the K-segment path would run is
+            # exactly what the aliasing pallas kernels already execute —
+            # minus the take_triangle copy, the window materializations and
+            # the whole-buffer dus round-trip below.  Ride the kernels.
+            # Cost convention follows explicit::shard_kernels: homogeneous
+            # model count stays dense, executed views carry the /2.
+            tracing.note("explicit::copy_free")
+            tracing.emit(
+                flops=flops, comm_bytes=comm, collectives=ncoll,
+                flops_vol=flops / 2, flops_max=flops / 2,
+            )
+        else:
+            tracing.emit(flops=flops / 2, comm_bytes=comm, collectives=ncoll)
         if args.side == "L":
             return pallas_tpu.tri_matmul(
                 A, B, a_uplo=args.uplo, a_trans=args.trans_a,
@@ -984,6 +1300,10 @@ def trmm(
                 a_view=b_view, b_view=a_view, out=out, out_off=out_off,
             )
         raise ValueError(f"side must be 'L' or 'R', got {args.side!r}")
+    if balance == "tile_cyclic_persistent":
+        return _trmm_persistent(
+            grid, A, B, args, mode, a_view, b_view, out, out_off, cyclic_tile
+        )
     Aw = _take_view(A, a_view)
     Bw = _take_view(B, b_view)
     T = masking.take_triangle(Aw, args.uplo)
@@ -1028,8 +1348,26 @@ def trmm(
             raise ValueError(f"side must be 'L' or 'R', got {args.side!r}")
     if args.alpha != 1.0:
         res = args.alpha * res
+    # copy-bytes attribution of this materializing path (the term the
+    # copy-free d==1 route and the persistent layout shrink): triangle mask,
+    # window slices, transpose, and the write-back round-trip — each priced
+    # as read + write of the moved array, per device
+    cb = _copy_bytes_of((2.0, T))  # take_triangle
+    if a_view is not None:
+        cb += _copy_bytes_of((2.0, T))
+    if args.diag == "U":
+        cb += _copy_bytes_of((2.0, T))
+    if args.trans_a:
+        cb += _copy_bytes_of((2.0, T))
+    if b_view is not None:
+        cb += _copy_bytes_of((2.0, Bw))
     if out is not None:
-        return grid.pin(lax.dynamic_update_slice(out, res.astype(out.dtype), out_off))
+        cb += _copy_bytes_of((2.0, out))  # whole-buffer dus round-trip
+        tracing.emit(copy_bytes=cb / grid.num_devices)
+        return grid.pin(
+            lax.dynamic_update_slice(out, res.astype(out.dtype), _i32_off(out_off))
+        )
+    tracing.emit(copy_bytes=cb / grid.num_devices)
     return grid.pin(res)
 
 
@@ -1052,13 +1390,21 @@ def syrk(
     .T — XLA emits the collective-permute when resharding is needed).
 
     trans=False: C = alpha*A@Aᵀ + beta*C;  trans=True: C = alpha*Aᵀ@A + beta*C.
-    In 'xla'/'explicit' modes the full dense symmetric result is computed
-    (MXU-friendly); callers that need only a triangle mask the output.
-    mode='pallas' (single-device grid) instead honors args.uplo: only that
-    triangle of the result is valid — with beta=0 the dead half is zeroed,
-    with beta!=0 it is UNDEFINED (the fused in-kernel beta*C accumulate
-    never visits dead tiles) — so callers must read only the args.uplo
-    triangle (models/cholesky.py symmetrizes its base-case panel from 'U').
+    In 'xla' mode (and 'explicit' on a mesh) the full dense symmetric
+    result is computed (MXU-friendly); callers that need only a triangle
+    mask the output.  mode='pallas' — and 'explicit' on a SINGLE-device
+    grid, which rides the same copy-free kernels — instead honors
+    args.uplo: only that triangle of the result is valid — with beta=0 the
+    dead half is zeroed, with beta!=0 it is UNDEFINED (the fused in-kernel
+    beta*C accumulate never visits dead tiles) — so callers must read only
+    the args.uplo triangle (models/cholesky.py symmetrizes its base-case
+    panel from 'U').
+
+    balance='tile_cyclic_persistent': storage contract as in trmm — all
+    buffers are in the symmetric tile-cyclic layout; the balanced
+    cyclic_out contraction runs without the three per-call shuffles of
+    'tile_cyclic', the symmetrize is cyclic-aware, and in_place writes
+    back through cyclic_window_update (band-sized, not buffer-sized).
 
     in_place (requires beta != 0 and a c_view): the update is written back
     INTO the C buffer at the c_view window and the whole updated buffer is
@@ -1075,7 +1421,11 @@ def syrk(
         raise ValueError("beta != 0 requires the accumulate operand C")
     if in_place and (args.beta == 0.0 or C is None):
         raise ValueError("in_place syrk requires the accumulate operand C")
-    if mode == "pallas" and grid.num_devices == 1:
+    if (
+        mode in ("pallas", "explicit")
+        and grid.num_devices == 1
+        and balance != "tile_cyclic_persistent"
+    ):
         if balance == "tile_cyclic":
             # same contract as trmm's pallas branch: the kernel skips dead
             # tiles itself, so the cyclic schedule is a no-op here — note it
@@ -1093,7 +1443,22 @@ def syrk(
         flops, comm, ncoll = tracing.gemm_cost(
             grid, n_out, n_out, k_in, jnp.result_type(A)
         )
-        tracing.emit(flops=flops / 2, comm_bytes=comm, collectives=ncoll)
+        if mode == "explicit":
+            # copy-free d==1 route, same reasoning as trmm's: at one device
+            # the explicit schedule's liveness is static and the aliasing
+            # kernels execute it without the materialization chain below.
+            # NOTE the contract narrows to the pallas one — only the
+            # args.uplo triangle of the result is valid (beta=0 zeroes the
+            # dead half, beta!=0 leaves it undefined); the in-repo explicit
+            # consumers (models/cholesky.py, the CQR gram) already read
+            # only that triangle, exactly as they do under mode='pallas'.
+            tracing.note("explicit::copy_free")
+            tracing.emit(
+                flops=flops, comm_bytes=comm, collectives=ncoll,
+                flops_vol=flops / 2, flops_max=flops / 2,
+            )
+        else:
+            tracing.emit(flops=flops / 2, comm_bytes=comm, collectives=ncoll)
         out_kw = {}
         if in_place:
             out_kw = dict(
@@ -1107,6 +1472,10 @@ def syrk(
             a_view=a_view, b_view=a_view,
             c=C, c_view=c_view, beta=args.beta,
             **out_kw,
+        )
+    if balance == "tile_cyclic_persistent":
+        return _syrk_persistent(
+            grid, A, C, args, mode, a_view, c_view, in_place, cyclic_tile
         )
     Aw = _take_view(A, a_view)
     if balance == "tile_cyclic" and mode != "explicit":
@@ -1160,11 +1529,26 @@ def syrk(
         out = _matmul(grid, Aop[0], Aop[1], mode, args.precision)
     if args.alpha != 1.0:
         out = args.alpha * out
+    # copy-bytes attribution (see trmm): the .T operand, window slices, the
+    # symmetrize's two triangle masks, and the write-back round-trip
+    cb = _copy_bytes_of((2.0, Aw))
+    if a_view is not None:
+        cb += _copy_bytes_of((2.0, Aw))
+    if mode == "explicit":
+        cb += _copy_bytes_of((4.0, out))
     if args.beta != 0.0:
-        out = out + args.beta * grid.pin(_take_view(C, c_view))
+        Cw = _take_view(C, c_view)
+        out = out + args.beta * grid.pin(Cw)
+        if c_view is not None:
+            cb += _copy_bytes_of((2.0, Cw))
     if in_place:
         off = (c_view[0], c_view[1]) if c_view is not None else (0, 0)
-        return grid.pin(lax.dynamic_update_slice(C, out.astype(C.dtype), off))
+        cb += _copy_bytes_of((2.0, C))  # whole-buffer dus round-trip
+        tracing.emit(copy_bytes=cb / grid.num_devices)
+        return grid.pin(
+            lax.dynamic_update_slice(C, out.astype(C.dtype), _i32_off(off))
+        )
+    tracing.emit(copy_bytes=cb / grid.num_devices)
     return grid.pin(out)
 
 

@@ -1,0 +1,79 @@
+"""Version compatibility for the small set of new-jax APIs the framework
+uses (jax >= 0.5 spellings), so the same source runs on the 0.4.x line.
+
+Scope is deliberately tiny — exactly the four surfaces the explicit
+shard_map schedule and the Mosaic kernels touch:
+
+* ``shard_map``          — ``jax.shard_map`` (new) vs
+                           ``jax.experimental.shard_map.shard_map`` (old).
+                           The old entry point has no vma type system; its
+                           ``check_rep`` analysis predates the schedules
+                           here, so the fallback always disables it — the
+                           out_specs still declare the contract.
+* ``pcast``              — ``lax.pcast`` casts replicated values to the
+                           varying type collectives expect under
+                           check_vma.  Without the vma system the cast is
+                           meaningless: identity.
+* ``vma_of``             — ``jax.typeof(x).vma`` where it exists, else an
+                           empty frozenset (nothing is vma-typed on old
+                           jax).
+* ``pallas_compiler_params`` — ``pltpu.CompilerParams`` was named
+                           ``TPUCompilerParams`` on the 0.4.x line.
+
+Everything degrades to the semantics the old APIs actually had; no
+behavior changes on new jax (the first branch is always the new API).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+_HAS_NEW_SHARD_MAP = hasattr(jax, "shard_map")
+
+if _HAS_NEW_SHARD_MAP:
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+else:
+    from jax.experimental.shard_map import shard_map as _shard_map_old
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        # old jax: no vma types; check_rep's replication analysis rejects
+        # valid schedules the vma system accepts, so it stays off
+        return _shard_map_old(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=False,
+        )
+
+
+def has_shard_map() -> bool:
+    """True when SOME shard_map entry point exists (new or experimental) —
+    the gate multi-device explicit-mode tests should probe instead of
+    ``hasattr(jax, "shard_map")``."""
+    return True  # import of this module already proved one exists
+
+
+if hasattr(lax, "pcast"):
+    pcast = lax.pcast
+else:
+    def pcast(x, axes, *, to="varying"):
+        return x
+
+
+if hasattr(jax, "typeof"):
+    def vma_of(x) -> frozenset:
+        return frozenset(getattr(jax.typeof(x), "vma", ()) or ())
+else:
+    def vma_of(x) -> frozenset:
+        return frozenset()
+
+
+def pallas_compiler_params(pltpu_module, **kwargs):
+    """Build pltpu.CompilerParams / TPUCompilerParams across the rename."""
+    cls = getattr(pltpu_module, "CompilerParams", None)
+    if cls is None:
+        cls = pltpu_module.TPUCompilerParams
+    return cls(**kwargs)

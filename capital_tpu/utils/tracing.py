@@ -187,6 +187,15 @@ class PhaseStats:
       the column that makes that cost visible (VERDICT r2 #4).
     Emitters that don't distinguish (dense ops, single device) leave both
     equal to ``flops``.
+
+    ``copy_bytes`` is the HBM traffic of pure data-movement the schedule
+    inserts around the matmuls — masked triangle materializations
+    (masking.take_triangle), window slices, transpose materializations, and
+    dynamic_update_slice write-backs (each priced as read + write of the
+    moved array).  The pallas view/alias kernels and the in-place explicit
+    route drive this term to ~0 (ISSUE 3); the materializing paths emit it
+    so autotune ranks the copy-free spelling and the trace tool's `copy`
+    bucket has a model-side counterpart.
     """
 
     calls: int = 0
@@ -195,6 +204,7 @@ class PhaseStats:
     collectives: int = 0  # collective count (synchronization/latency terms)
     flops_vol: float = 0.0  # executed, volumetric mean per device
     flops_max: float = 0.0  # executed, max over devices (critical path)
+    copy_bytes: float = 0.0  # HBM bytes of schedule-inserted copies, per device
 
     def merge(self, other: "PhaseStats") -> None:
         self.calls += other.calls
@@ -203,6 +213,7 @@ class PhaseStats:
         self.collectives += other.collectives
         self.flops_vol += other.flops_vol
         self.flops_max += other.flops_max
+        self.copy_bytes += other.copy_bytes
 
 
 @contextlib.contextmanager
@@ -235,13 +246,15 @@ def emit(
     collectives: int = 0,
     flops_vol: float | None = None,
     flops_max: float | None = None,
+    copy_bytes: float = 0.0,
 ) -> None:
     """Attribute model costs to the innermost active phase.
 
     Called by the SUMMA layer and algorithm base cases at trace time; no-op
     unless a Recorder is active (zero overhead in production paths).
     flops_vol/flops_max (executed volumetric / max-per-process views)
-    default to `flops` — the homogeneous assumption."""
+    default to `flops` — the homogeneous assumption.  copy_bytes prices
+    schedule-inserted data movement (see PhaseStats)."""
     if not _ACTIVE or _MUTED:
         return
     tag = _SCOPE_STACK[-1] if _SCOPE_STACK else "<top>"
@@ -253,6 +266,7 @@ def emit(
         st.collectives += collectives
         st.flops_vol += flops if flops_vol is None else flops_vol
         st.flops_max += flops if flops_max is None else flops_max
+        st.copy_bytes += copy_bytes
 
 
 def note(tag: str) -> None:
@@ -303,13 +317,17 @@ class Recorder:
         plus collectives x alpha — the synchronization count the model
         already tracks; pricing bytes only under-ranked latency-bound
         small-N / high-q configs (each num_chunks slice adds an alpha,
-        not bytes)."""
+        not bytes).  Schedule-inserted copies (copy_bytes) are local HBM
+        traffic, priced at hbm_gbps into the comp term — they spend device
+        time, not interconnect time, which is exactly why the copy-free
+        explicit route ranks above the materializing one at equal flops."""
         spec = spec or device_spec()
         peak = spec.peak_tflops(dtype) * 1e12 * efficiency
         out = {}
         for tag, s in self.stats.items():
             comm = s.comm_bytes / (spec.ici_gbps * 1e9) + s.collectives * spec.alpha_s
-            out[tag] = (s.flops / peak, comm)
+            comp = s.flops / peak + s.copy_bytes / (spec.hbm_gbps * 1e9)
+            out[tag] = (comp, comm)
         return out
 
 
@@ -489,7 +507,9 @@ def write_costs_table(path: str, rows: list[tuple[str, Recorder]]) -> None:
     plus critter's other two compute views (util.h:63-127, tune.cpp:79-82):
     comp-vol (volumetric executed, mean per device) and comp-max
     (max-per-process, the critical-path device; with block-distributed
-    triangular operands up to ~2x comp-vol — see summa.tri_fractions)."""
+    triangular operands up to ~2x comp-vol — see summa.tri_fractions) —
+    plus the copy column (copy_bytes: schedule-inserted HBM data movement;
+    ~0 on the view/alias routes, docs/OBSERVABILITY.md)."""
     tags = sorted({t for _, rec in rows for t in rec.stats})
     table = [
         ["Config"]
@@ -498,6 +518,7 @@ def write_costs_table(path: str, rows: list[tuple[str, Recorder]]) -> None:
         + [f"{t}-comp-max" for t in tags]
         + [f"{t}-comm" for t in tags]
         + [f"{t}-synch" for t in tags]
+        + [f"{t}-copy" for t in tags]
     ]
     for cid, rec in rows:
         table.append(
@@ -507,6 +528,7 @@ def write_costs_table(path: str, rows: list[tuple[str, Recorder]]) -> None:
             + [f"{rec.stats[t].flops_max:.3e}" if t in rec.stats else "0" for t in tags]
             + [f"{rec.stats[t].comm_bytes:.3e}" if t in rec.stats else "0" for t in tags]
             + [str(rec.stats[t].collectives) if t in rec.stats else "0" for t in tags]
+            + [f"{rec.stats[t].copy_bytes:.3e}" if t in rec.stats else "0" for t in tags]
         )
     with open(path, "w") as f:
         f.write(_rows_to_text(table))

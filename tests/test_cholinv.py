@@ -139,6 +139,55 @@ class TestFactor:
                 g, A, CholinvConfig(balance="tile_cyclic", mode="xla")
             )
 
+    def test_persistent_layout_matches_block(self, grid2x2x1):
+        # balance='tile_cyclic_persistent': ONE symmetric tile-cyclic
+        # permute at entry, every recursion window read/written through
+        # chunk-local reshapes, un-permute at exit — vs 'tile_cyclic'
+        # paying 2-3 row shuffles inside every trmm/syrk call.  Results
+        # must match the block schedule to reduction-order roundoff on
+        # both the aligned and the padded/cropped (n=100) paths.
+        from capital_tpu.utils import tracing
+
+        g = grid2x2x1
+        for n, sip in ((128, False), (128, True), (100, False)):
+            A = jax.device_put(_spd(n), g.face_sharding())
+            block = CholinvConfig(
+                base_case_dim=16, mode="explicit", complete_inv=True,
+                schur_in_place=sip,
+            )
+            pers = CholinvConfig(
+                base_case_dim=16, mode="explicit", complete_inv=True,
+                schur_in_place=sip, balance="tile_cyclic_persistent",
+            )
+            Rb, RIb = jax.jit(lambda a: cholesky.factor(g, a, block))(A)
+            with tracing.Recorder() as rec:
+                Rp, RIp = jax.jit(lambda a: cholesky.factor(g, a, pers))(A)
+            assert "cholinv::persistent_fallback" not in rec.stats, (n, sip)
+            assert "syrk::persistent_cyclic" in rec.stats, sorted(rec.stats)
+            np.testing.assert_allclose(np.asarray(Rp), np.asarray(Rb), atol=1e-11)
+            np.testing.assert_allclose(np.asarray(RIp), np.asarray(RIb), atol=1e-10)
+            assert residual.cholesky_residual(A, Rp) < 1e-14
+            assert residual.cholesky_inverse_residual(Rp, RIp) < 1e-13
+
+    def test_persistent_ineligible_falls_back_with_note(self, grid2x2x2):
+        # the cholinv ENTRY is where persistent eligibility is decided
+        # (before any buffer is permuted), so unlike summa's raising
+        # storage contract a c=2 / misaligned topology falls back to the
+        # block schedule — with a note, never silently
+        from capital_tpu.utils import tracing
+
+        g = grid2x2x2
+        A = jax.device_put(_spd(64), g.face_sharding())
+        cfg = CholinvConfig(
+            base_case_dim=16, mode="explicit",
+            balance="tile_cyclic_persistent",
+        )
+        with tracing.Recorder() as rec:
+            R, _ = jax.jit(lambda a: cholesky.factor(g, a, cfg))(A)
+        assert rec.stats["cholinv::persistent_fallback"].calls >= 1
+        assert "syrk::persistent_cyclic" not in rec.stats
+        assert residual.cholesky_residual(A, R) < 1e-14
+
     @pytest.mark.parametrize("split", [1, 2])
     @pytest.mark.parametrize("mode", ["xla", "explicit"])
     def test_split_and_mode_knobs(self, grid2x2x2, split, mode):

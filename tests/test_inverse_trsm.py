@@ -84,6 +84,37 @@ class TestRectri:
         assert "trmm::tile_cyclic_fallback" not in rec.stats, rec.stats.keys()
         assert any("RT::merge" in k for k in rec.stats), rec.stats.keys()
 
+    def test_cross_level_assembly_pinned(self, grid2x2x1):
+        """Pin the documented DECISION on the reference's rectri TODO
+        (inverse.py module docstring; rectri.hpp:70-99): the cross-level
+        assembly IS implemented — windowed trmms over one flat buffer on
+        the full mesh, no nested-grid redistribution — so the top-level
+        windows of the result must equal the closed-form block inverse
+        [[L11inv, 0], [-L22inv @ L21 @ L11inv, L22inv]] computed
+        independently, with the never-written upper block EXACTLY zero
+        (each window is written once; nothing is masked after the fact)."""
+        n, bc = 128, 32
+        T = _tri(n, "L")
+        Td = jax.device_put(T, grid2x2x1.face_sharding())
+        Ti = np.asarray(
+            jax.jit(
+                lambda t: inverse.rectri(
+                    grid2x2x1, t, "L", RectriConfig(base_case_dim=bc)
+                )
+            )(Td)
+        )
+        L = np.asarray(T, dtype=np.float64)
+        # the bc-aligned split rule at the top level: n1 = (n//bc//2)*bc
+        n1 = (n // bc // 2) * bc
+        L11i = np.linalg.inv(L[:n1, :n1])
+        L22i = np.linalg.inv(L[n1:, n1:])
+        np.testing.assert_allclose(Ti[:n1, :n1], L11i, rtol=1e-10, atol=1e-12)
+        np.testing.assert_allclose(Ti[n1:, n1:], L22i, rtol=1e-10, atol=1e-12)
+        np.testing.assert_allclose(
+            Ti[n1:, :n1], -L22i @ L[n1:, :n1] @ L11i, rtol=1e-10, atol=1e-11
+        )
+        assert np.all(Ti[:n1, n1:] == 0.0)
+
     def test_bad_inputs(self, grid2x2x1):
         with pytest.raises(ValueError):
             inverse.rectri(grid2x2x1, jnp.zeros((4, 6)))

@@ -65,27 +65,58 @@ def test_pack_parity():
 
 
 def test_predict_matches_fallback():
-    """C++ planner and the NumPy reference model must stay in lock-step."""
+    """C++ planner and the NumPy reference model must stay in lock-step,
+    including the copy-bytes term's balance knob."""
     bcs = [64, 128, 256]
     pols = [BaseCasePolicy.REPLICATE_COMM_COMP, BaseCasePolicy.NO_REPLICATION]
     for grid in [(1, 1, 1), (2, 2, 1), (2, 2, 2)]:
-        out, best = native.cholinv_predict(
-            2048, grid, bcs, pols, peak_flops=1e14,
-        )
-        ref = np.array(
-            [
+        for bal in ("block", "tile_cyclic_persistent"):
+            out, best = native.cholinv_predict(
+                2048, grid, bcs, pols, peak_flops=1e14, balance=bal,
+            )
+            ref = np.array(
                 [
-                    native._predict_py(
-                        2048, *grid, 1e14, 4.5e10, 1e-6, 2, bc, p.value, 1, True
-                    )
-                    for bc in bcs
+                    [
+                        native._predict_py(
+                            2048, *grid, 1e14, 4.5e10, 1e-6, 2, bc, p.value,
+                            1, True, 0, int(bal != "block"),
+                        )
+                        for bc in bcs
+                    ]
+                    for p in pols
                 ]
-                for p in pols
-            ]
-        )
-        np.testing.assert_allclose(out, ref, rtol=1e-12)
-        assert out[best] == out.min()
-        assert np.all(out > 0)
+            )
+            np.testing.assert_allclose(out, ref, rtol=1e-12)
+            assert out[best] == out.min()
+            assert np.all(out > 0)
+
+
+def test_predict_copy_term():
+    """The copy-bytes term mirrors the runtime's emissions: materializing
+    whole-buffer round-trips on a mesh, band-sized residue under the
+    persistent layout, nothing at all on one device (the copy-free d==1
+    route)."""
+    bcs = [128]
+    pols = [BaseCasePolicy.REPLICATE_COMM_COMP]
+    kw = dict(peak_flops=1e14)
+    blk, _ = native.cholinv_predict(8192, (2, 2, 1), bcs, pols, **kw)
+    per, _ = native.cholinv_predict(
+        8192, (2, 2, 1), bcs, pols, balance="tile_cyclic_persistent", **kw
+    )
+    # the persistent layout's band-sized residue + 3 lifetime permutes
+    # must undercut the materializing schedule's per-phase P^2 round-trips
+    assert per[0, 0] < blk[0, 0]
+    # d==1: balance changes nothing — there is no copy term to remove
+    one_b, _ = native.cholinv_predict(8192, (1, 1, 1), bcs, pols, **kw)
+    one_p, _ = native.cholinv_predict(
+        8192, (1, 1, 1), bcs, pols, balance="tile_cyclic_persistent", **kw
+    )
+    np.testing.assert_allclose(one_b, one_p)
+    # and the term is real: an infinitely fast HBM recovers the old model
+    fast, _ = native.cholinv_predict(
+        8192, (2, 2, 1), bcs, pols, hbm_bytes_per_s=1e30, **kw
+    )
+    assert fast[0, 0] < blk[0, 0]
 
 
 def test_predict_chunks_axis():

@@ -125,3 +125,82 @@ class TestCriticalPlane:
             "CI::tmu": pytest.approx(2e-3),
             "copy": pytest.approx(1e-3),
         }
+
+
+class TestCopyFraction:
+    """check_copy_fraction: the CI gate that the explicit-cholinv 'copy'
+    bucket stays below a pinned fraction of device own-time — the trace
+    counterpart of the collective-inventory audit.  The explicit schedule
+    now rides copy-free / persistent-layout routes, so its pinned budget is
+    tight: a take_triangle materialization or whole-buffer
+    dynamic_update_slice creeping back in trips the gate loudly."""
+
+    # pinned CI budget for the explicit cholinv trace (the copy-free d==1
+    # route plus the persistent layout leave only band-sized residue)
+    EXPLICIT_CHOLINV_COPY_BUDGET = 0.10
+
+    def _explicit_cholinv_budget(self, copy_ms):
+        # shape of a real explicit-cholinv device budget: phase buckets
+        # dominate, 'copy' carries whatever the schedule materialized
+        return {
+            "CI::tmu": 6.0,
+            "CI::trsm": 2.5,
+            "CI::inv": 1.0,
+            "CI::factor_diag": 0.4,
+            "fusion": 0.1,
+            "copy": copy_ms,
+            "async (overlapped)": 50.0,  # DMA occupancy: excluded
+        }
+
+    def test_within_budget_returns_fraction(self):
+        budget = self._explicit_cholinv_budget(copy_ms=0.5)
+        frac = trace.check_copy_fraction(
+            budget, self.EXPLICIT_CHOLINV_COPY_BUDGET, "cholinv explicit"
+        )
+        assert frac == pytest.approx(0.5 / 10.5)
+        assert frac <= self.EXPLICIT_CHOLINV_COPY_BUDGET
+
+    def test_regression_fails_loudly(self):
+        # the pre-copy-free schedule's shape: dozens of whole-buffer
+        # round-trips put 'copy' at a third of device time
+        budget = self._explicit_cholinv_budget(copy_ms=5.0)
+        with pytest.raises(RuntimeError, match="copy-budget regression"):
+            trace.check_copy_fraction(
+                budget, self.EXPLICIT_CHOLINV_COPY_BUDGET, "cholinv explicit"
+            )
+
+    def test_async_occupancy_excluded_both_sides(self):
+        # async DMA occupancy overlaps compute — it must inflate neither
+        # the numerator nor the denominator
+        with_async = self._explicit_cholinv_budget(copy_ms=0.5)
+        without = dict(with_async)
+        without.pop("async (overlapped)")
+        f1 = trace.check_copy_fraction(with_async, 0.1)
+        f2 = trace.check_copy_fraction(without, 0.1)
+        assert f1 == f2
+
+    def test_empty_and_copyless_budgets(self):
+        assert trace.check_copy_fraction({}, 0.1) == 0.0
+        assert trace.check_copy_fraction({"CI::tmu": 3.0}, 0.0) == 0.0
+
+    def test_from_synthesized_xplane(self):
+        # end-to-end through the plane parser: a synthesized trace whose
+        # copy share violates the pinned budget must trip the gate
+        ps = 1_000_000
+        space = xplane_pb2.XSpace()
+        plane = space.planes.add(name="/device:TPU:0 (pid 1)")
+        line = plane.lines.add(name="XLA Ops")
+        for mid, (off, dur, op) in enumerate([
+            (0, 6 * ps, "%CI.tmu.1 = f(...)"),
+            (6 * ps, 3 * ps, "%copy.7 = bf16[8192,8192] copy(%buf)"),
+        ], start=1):
+            line.events.add(offset_ps=off, duration_ps=dur, metadata_id=mid)
+            plane.event_metadata[mid].name = op
+        budget = trace._critical_plane_budget([("t", space)])
+        with pytest.raises(RuntimeError, match="copy-budget regression"):
+            trace.check_copy_fraction(
+                budget, self.EXPLICIT_CHOLINV_COPY_BUDGET, "cholinv explicit"
+            )
+        assert (
+            trace.check_copy_fraction(budget, 0.5) == pytest.approx(3 / 9)
+        )

@@ -5,7 +5,8 @@
 
 PY ?= python
 
-.PHONY: all test benchmarking bench-explicit tune audit robust native clean
+.PHONY: all test benchmarking bench-explicit tune audit robust serve-smoke \
+	native clean
 
 all: test
 
@@ -35,10 +36,21 @@ tune:
 
 # model-vs-compiled drift gate on the flagship configs (docs/OBSERVABILITY.md);
 # compile-only — runs in CI without a TPU (exit non-zero on drift)
-audit:
+audit: serve-smoke
 	$(PY) -m capital_tpu.obs audit cholinv --n 4096 --platform cpu
 	$(PY) -m capital_tpu.obs audit cacqr --m 16384 --n 512 --platform cpu
 	$(PY) -m capital_tpu.obs robust-gate --platform cpu
+
+# serving self-check (docs/SERVING.md): mixed-bucket CPU workload through
+# the SolveEngine, one serve:request_stats ledger record, gated on 100%
+# post-warmup cache hit-rate (zero steady-state recompiles) + the pinned
+# per-request residual gates inside the smoke itself
+serve-smoke:
+	rm -f serve_smoke.jsonl
+	$(PY) -m capital_tpu.serve smoke --platform cpu --requests 50 \
+		--ledger serve_smoke.jsonl
+	$(PY) -m capital_tpu.obs serve-report serve_smoke.jsonl \
+		--min-hit-rate 1.0
 
 # breakdown detection / shifted-CholeskyQR recovery / fault-injection suite
 # (docs/ROBUSTNESS.md); CPU rig — tests/conftest.py provides the 8-device
@@ -50,5 +62,5 @@ native:
 	$(PY) -c "from capital_tpu import native; print('native engine available:', native.available())"
 
 clean:
-	rm -rf autotune_out .pytest_cache bench_explicit.jsonl
+	rm -rf autotune_out .pytest_cache bench_explicit.jsonl serve_smoke.jsonl
 	find . -name __pycache__ -type d -exec rm -rf {} +

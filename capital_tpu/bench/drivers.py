@@ -179,10 +179,21 @@ def _timed(args, step, operand, coupling: str = "full", loop=None) -> tuple[floa
     # Callers with operands _make_loop cannot carry (the trsm driver's
     # (L, B) tuple) pass their own loop of the same shape.
     loop = loop or harness._make_loop(step, coupling)
+    samples: list[float] = []
     t = harness.timed_loop(
-        step, operand, iters=args.iters, coupling=coupling, loop=loop
+        step, operand, iters=args.iters, coupling=coupling, loop=loop,
+        samples_out=samples,
     )
     extra: dict = {}
+    if len(samples) >= 2:
+        # per-iteration wall spread (paired-delta samples at the resolved
+        # trip count) through the shared quantile helper — the same
+        # p50/p95/p99 shape serve/stats.py reports, so bench rows and
+        # request_stats records read on one scale
+        extra["wall_ms"] = {
+            k: round(v * 1e3, 3)
+            for k, v in harness.percentiles(samples).items()
+        }
     if getattr(args, "device_check", False):
         dms = harness.device_ms_per_iter(
             step, operand, iters=max(3, args.iters), coupling=coupling, loop=loop

@@ -136,21 +136,53 @@ def noise_band_seconds() -> float:
     return 0.05 if _jax.default_backend() == "tpu" else 0.002
 
 
+def percentiles(
+    samples, points: tuple[float, ...] = (50.0, 95.0, 99.0)
+) -> dict[str, float]:
+    """Nearest-rank percentiles of raw samples: {'p50': ..., 'p95': ...,
+    'p99': ...}.  The ONE quantile implementation shared by the bench
+    report lines (drivers._timed's wall_ms block) and the serving layer's
+    latency stats (serve/stats.py) — duplicated quantile code is how two
+    dashboards end up disagreeing about the same run.
+
+    Nearest-rank (ceil) deliberately: every reported value is a sample that
+    actually occurred, so a p99 can be shown next to the raw max without
+    interpolation artifacts.  Dependency-free (no numpy) so stats paths add
+    zero imports."""
+    s = sorted(samples)
+    if not s:
+        raise ValueError("percentiles() needs at least one sample")
+    import math
+
+    out = {}
+    for p in points:
+        if not 0.0 < p <= 100.0:
+            raise ValueError(f"percentile point {p} outside (0, 100]")
+        rank = max(1, math.ceil(p / 100.0 * len(s)))
+        label = f"p{int(p)}" if float(p).is_integer() else f"p{p}"
+        out[label] = s[rank - 1]
+    return out
+
+
 def _resolve_delta(
-    run, k: int, cap: int, repeats: int, noise: float
+    run, k: int, cap: int, repeats: int, noise: float, samples_out=None
 ) -> tuple[float, float, int]:
     """The one escalate-until-the-delta-clears-the-noise-band loop shared by
     every protocol (timed_loop, timed_oneshot x2): returns (per-iter
     seconds, raw delta, final trip count).  Callers decide what an
     unresolved result means."""
-    t, delta = paired_median_delta(run, k, repeats)
+    t, delta = paired_median_delta(run, k, repeats, samples_out)
     while k < cap and delta < noise:
         k = min(cap, max(k * 2, int(3.0 * noise / max(t, 1e-9))))
-        t, delta = paired_median_delta(run, k, repeats)
+        if samples_out is not None:
+            samples_out.clear()  # samples from a rejected trip count
+        t, delta = paired_median_delta(run, k, repeats, samples_out)
     return t, delta, k
 
 
-def paired_median_delta(run, k: int, nrep: int) -> tuple[float, float]:
+def paired_median_delta(
+    run, k: int, nrep: int, samples_out=None
+) -> tuple[float, float]:
     """(per-iteration seconds, raw delta): median over INTERLEAVED
     (base, full) wall pairs of `run(1)` vs `run(k+1)`.
 
@@ -162,7 +194,12 @@ def paired_median_delta(run, k: int, nrep: int) -> tuple[float, float]:
     200-iteration sustained marginal is 24.9 ms).  The median rejects
     jitter outliers — a single paired delta can even go negative for sub-ms
     steps, which once let an autotune sweep crown a config with a negative
-    "time"."""
+    "time".
+
+    `samples_out` (a list) collects the raw per-iteration seconds of each
+    pair (delta / k) for percentile reporting (percentiles()); individual
+    samples keep the jitter the median rejects — including possible
+    negatives — which is exactly what a spread statistic should see."""
     import statistics
 
     deltas = []
@@ -170,6 +207,8 @@ def paired_median_delta(run, k: int, nrep: int) -> tuple[float, float]:
         b = run(1)
         f = run(k + 1)
         deltas.append(f - b)
+    if samples_out is not None:
+        samples_out.extend(d / k for d in deltas)
     d = statistics.median(deltas)
     return d / k, d
 
@@ -242,6 +281,7 @@ def timed_loop(
     repeats: int = 3,
     coupling: str = "full",
     loop=None,
+    samples_out=None,
 ) -> float:
     """Per-iteration seconds of `step`, run `iters` times inside jit —
     the median over interleaved (1-trip, iters+1-trip) wall pairs
@@ -281,13 +321,16 @@ def timed_loop(
         return time.perf_counter() - t0
 
     run(1)  # compile (dynamic trip count -> one executable reused for both k)
-    t, delta = paired_median_delta(run, iters, repeats + 2)
+    t, delta = paired_median_delta(run, iters, repeats + 2, samples_out)
     # Escalate the trip count until the DELTA clears the noise band: a
     # positive but small delta is still mostly noise (a ~2ms step was
     # observed reporting 13ms when the total delta sat at ~40ms).
     noise = noise_band_seconds()
     if delta < noise:
-        t, delta, k = _resolve_delta(run, iters, 4096, repeats, noise)
+        if samples_out is not None:
+            samples_out.clear()  # below-noise samples from the first pass
+        t, delta, k = _resolve_delta(run, iters, 4096, repeats, noise,
+                                     samples_out)
     else:
         k = iters
     if t <= 0.0 or delta < noise:

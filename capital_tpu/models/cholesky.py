@@ -704,6 +704,31 @@ def factor_buffers(
     )
 
 
+def solve(
+    grid: Grid,
+    A: jnp.ndarray,
+    B: jnp.ndarray,
+    cfg: CholinvConfig = CholinvConfig(),
+):
+    """SPD solve A·X = B: cholinv factor + the two-trsm potrs sweeps
+    (ops/lapack.potrs) — the posv capability serve.api rides (docs/SERVING.md).
+
+    Runs the factorization with complete_inv=False: the solve consumes only
+    R (potrs back-substitutes), so the inverse-completion trmms of the full
+    R⁻¹ are skipped work here.  With cfg.robust set the return is (X, info)
+    — info the int32 breakdown status of the factor (0 clean); X is
+    garbage when info != 0 and must not be trusted.  Callers that already
+    hold a factor should call lapack.potrs directly."""
+    if B.shape[0] != A.shape[0]:
+        raise ValueError(f"shape mismatch: A {A.shape} vs B {B.shape}")
+    ccfg = dataclasses.replace(cfg, complete_inv=False)
+    if cfg.robust is not None:
+        R, _, info = factor(grid, A, ccfg)
+        return lapack.potrs(R, B, uplo="U"), info
+    R, _ = factor(grid, A, ccfg)
+    return lapack.potrs(R, B, uplo="U")
+
+
 def spd_inverse(
     grid: Grid, A: jnp.ndarray, cfg: CholinvConfig = CholinvConfig()
 ) -> jnp.ndarray:

@@ -14,6 +14,10 @@ exit 2 means the ledgers are not comparable (schema/device mismatch).
 breakdown-recovery/failure record must pass diff un-flagged while the same
 value drop WITHOUT the status still flags (docs/ROBUSTNESS.md).
 
+``serve-report`` summarizes the serve:request_stats records of a ledger
+(serve/stats.py; docs/SERVING.md) and optionally gates on cache hit-rate /
+p99 latency — the second half of ``make serve-smoke``.
+
 Examples::
 
     python -m capital_tpu.obs audit cholinv --n 4096
@@ -181,6 +185,61 @@ def _robust_gate(args) -> int:
     return 0
 
 
+def _serve_report(args) -> int:
+    """Summarize the serve:request_stats records of a ledger, with optional
+    gates (the `make serve-smoke` second half).  Exit 2 on a malformed
+    record, 1 on a gate failure (or gates requested with no records)."""
+    from capital_tpu.obs import ledger
+
+    recs = ledger.read(args.ledger)
+    rows = [r for r in recs if r.get("request_stats") is not None]
+    bad = 0
+    for i, r in enumerate(rows):
+        for p in ledger.validate_request_stats(r["request_stats"]):
+            print(f"malformed request_stats record #{i}: {p}",
+                  file=sys.stderr)
+            bad += 1
+    if bad:
+        return 2
+    gates_on = args.min_hit_rate is not None or args.max_p99_ms is not None
+    if not rows:
+        print(f"# no request_stats records in {args.ledger} "
+              f"({len(recs)} records total)")
+        return 1 if gates_on else 0
+    failures = []
+    for i, r in enumerate(rows):
+        rs = r["request_stats"]
+        man = r.get("manifest") or {}
+        cache = rs["cache"]
+        lat = rs["latency_ms"]
+        print(
+            f"# [{i}] {man.get('platform', '?')}/{man.get('device', '?')} "
+            f"requests={rs['requests']} ok={rs['ok']} "
+            f"flagged={rs['flagged']} failed={rs['failed']} "
+            f"latency_ms p50={lat['p50']} p95={lat['p95']} p99={lat['p99']} "
+            f"occupancy={rs['batch_occupancy_mean']} "
+            f"queue_max={rs['queue_depth_max']} "
+            f"cache hits={cache['hits']} misses={cache['misses']} "
+            f"hit_rate={cache['hit_rate']:.3f}"
+        )
+        if (args.min_hit_rate is not None
+                and cache["hit_rate"] < args.min_hit_rate):
+            failures.append(
+                f"record #{i}: hit_rate {cache['hit_rate']:.3f} < "
+                f"{args.min_hit_rate}"
+            )
+        if args.max_p99_ms is not None and lat["p99"] > args.max_p99_ms:
+            failures.append(
+                f"record #{i}: p99 {lat['p99']}ms > {args.max_p99_ms}ms"
+            )
+    for f in failures:
+        print(f"serve-report gate FAIL: {f}", file=sys.stderr)
+    if failures:
+        return 1
+    print(f"# serve-report OK ({len(rows)} request_stats record(s))")
+    return 0
+
+
 def _diff(args) -> int:
     from capital_tpu.obs import ledger
 
@@ -247,6 +306,17 @@ def build_parser() -> argparse.ArgumentParser:
     d.add_argument("--tol-hbm", type=float, default=0.05)
     d.add_argument("--tol-collective", type=int, default=0)
     d.set_defaults(fn=_diff)
+
+    s = sub.add_parser(
+        "serve-report",
+        help="summarize serve request_stats records (optional gates)",
+    )
+    s.add_argument("ledger")
+    s.add_argument("--min-hit-rate", type=float, default=None,
+                   help="fail unless every record's cache hit_rate >= this")
+    s.add_argument("--max-p99-ms", type=float, default=None,
+                   help="fail when any record's p99 latency exceeds this")
+    s.set_defaults(fn=_serve_report)
 
     g = sub.add_parser(
         "robust-gate",

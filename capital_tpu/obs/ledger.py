@@ -225,6 +225,58 @@ def _check_comparable(a: dict, b: dict) -> None:
         )
 
 
+#: request_stats schema (serve/stats.Collector.snapshot): required keys and
+#: the nested latency/cache shapes.  diff() VALIDATES these instead of
+#: metric-comparing them — a served mix's latency profile is workload, but a
+#: malformed record means the producer and the tooling have drifted apart.
+_REQ_STATS_COUNTS = ("requests", "ok", "flagged", "failed",
+                     "queue_depth_max", "batches")
+_REQ_STATS_PCTS = ("p50", "p95", "p99")
+_REQ_STATS_CACHE = ("hits", "misses", "warmup_compiles", "hit_rate")
+
+
+def validate_request_stats(block) -> list[str]:
+    """Schema problems of one request_stats block ([] = valid).  Checked by
+    diff() on every record carrying the block and by ``obs serve-report``;
+    kept as a problem list (not an exception) so the CLI can print all of
+    them at once."""
+    if not isinstance(block, dict):
+        return [f"request_stats is {type(block).__name__}, expected object"]
+    probs = []
+    if block.get("schema_version") != SCHEMA_VERSION:
+        probs.append(
+            f"schema_version {block.get('schema_version')!r} != "
+            f"{SCHEMA_VERSION}"
+        )
+    for key in _REQ_STATS_COUNTS:
+        v = block.get(key)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            probs.append(f"{key} must be a non-negative int, got {v!r}")
+    lat = block.get("latency_ms")
+    if not isinstance(lat, dict):
+        probs.append(f"latency_ms must be an object, got {lat!r}")
+    else:
+        for p in _REQ_STATS_PCTS:
+            if not isinstance(lat.get(p), (int, float)):
+                probs.append(f"latency_ms.{p} missing or non-numeric")
+    cache = block.get("cache")
+    if not isinstance(cache, dict):
+        probs.append(f"cache must be an object, got {cache!r}")
+    else:
+        for c in _REQ_STATS_CACHE:
+            if not isinstance(cache.get(c), (int, float)):
+                probs.append(f"cache.{c} missing or non-numeric")
+        hr = cache.get("hit_rate")
+        if isinstance(hr, (int, float)) and not 0.0 <= hr <= 1.0:
+            probs.append(f"cache.hit_rate {hr!r} outside [0, 1]")
+    occ = block.get("batch_occupancy_mean")
+    if not isinstance(occ, (int, float)) or not 0.0 <= occ <= 1.0:
+        probs.append(
+            f"batch_occupancy_mean must be in [0, 1], got {occ!r}"
+        )
+    return probs
+
+
 def _event_status(rec: dict) -> Optional[str]:
     """The robustness status of a record, when it carries one.
 
@@ -235,7 +287,12 @@ def _event_status(rec: dict) -> Optional[str]:
     are exempt from the measured-value comparison in diff(): a run that
     paid recovery sweeps (or failed outright) is slower BY DESIGN, and
     reading that as a throughput regression would teach people to strip
-    the robust path before benchmarking."""
+    the robust path before benchmarking.  'serve' marks request_stats
+    records (serve/stats.py): a served workload's latency mix is the
+    workload's property, not a kernel's — its regression story is
+    ``obs serve-report`` gates, not the bench metric check."""
+    if rec.get("request_stats") is not None:
+        return "serve"
     ev = rec.get("event")
     if isinstance(ev, dict) and ev.get("status"):
         return str(ev["status"])
@@ -266,7 +323,19 @@ def diff(
     the freshest trial).  Records carrying a failure/recovery status
     (_event_status) skip ONLY the measured-value check — their walls
     include recovery work or are absent entirely; the structural checks
-    (collectives, peak HBM) still apply."""
+    (collectives, peak HBM) still apply.  request_stats records are exempt
+    the same way, but their block must VALIDATE
+    (validate_request_stats) — a malformed one raises LedgerIncompatible
+    like any other apples-to-oranges input."""
+    a_recs, b_recs = list(a_recs), list(b_recs)
+    for r in (*a_recs, *b_recs):
+        rs = r.get("request_stats")
+        if rs is not None:
+            probs = validate_request_stats(rs)
+            if probs:
+                raise LedgerIncompatible(
+                    "malformed request_stats record: " + "; ".join(probs)
+                )
     a_by = {_key(r): r for r in a_recs}
     b_by = {_key(r): r for r in b_recs}
     out: list[Regression] = []

@@ -54,6 +54,32 @@ def potrf(A: jnp.ndarray, uplo: str = "U", with_info: bool = False):
     return (T, detect.factor_info(T)) if with_info else T
 
 
+def potrs(T: jnp.ndarray, B: jnp.ndarray, uplo: str = "U") -> jnp.ndarray:
+    """SPD solve A·X = B from an EXISTING Cholesky factor via two triangular
+    (trsm) sweeps — LAPACKE_dpotrs for this seam.  With uplo='U'
+    (A = RᵀR, T = R): solve Rᵀ·Y = B then R·X = Y; with uplo='L'
+    (A = LLᵀ, T = L): L·Y = B then Lᵀ·X = Y.
+
+    Leading batch dimensions of (T, B) solve as a stack (both sweeps are one
+    batched triangular_solve each), which is what serve's vmap micro-batching
+    rides.  Runs at the >= f32 compute dtype like the factor itself and casts
+    back once."""
+    if uplo not in ("U", "L"):
+        raise ValueError(f"uplo must be 'U' or 'L', got {uplo!r}")
+    ct = _compute_dtype(T.dtype)
+    Tc, Bc = T.astype(ct), B.astype(ct)
+    lower = uplo == "L"
+    # the transposed sweep comes first for 'U' (Rᵀ then R), second for 'L'
+    # (L then Lᵀ); `lower` describes the stored triangle of T in both.
+    Y = lax.linalg.triangular_solve(
+        Tc, Bc, left_side=True, lower=lower, transpose_a=not lower
+    )
+    X = lax.linalg.triangular_solve(
+        Tc, Y, left_side=True, lower=lower, transpose_a=lower
+    )
+    return X.astype(B.dtype)
+
+
 def trtri(T: jnp.ndarray, uplo: str = "U", unit_diag: bool = False) -> jnp.ndarray:
     """Inverse of a triangular matrix.  Reference lapack::engine::_trtri
     (interface.hpp:46-59).  Leading batch dimensions invert as a stack in

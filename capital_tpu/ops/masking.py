@@ -68,6 +68,34 @@ def take_triangle_cyclic(
     return A * m.astype(A.dtype)
 
 
+def embed_identity_tail(X: jnp.ndarray, rows: int, cols: int) -> jnp.ndarray:
+    """Zero-pad the (m, n) matrix X to (rows, cols) and put ones where padded
+    row m+j meets padded column n+j — the rank-safe rectangular pad behind
+    serve's shape bucketing (serve/batching.py).
+
+    For square X with rows == cols this is exactly diag(X, I) — the SPD-safe
+    pad of models/cholesky.pad_embed_identity (diag(A, I) factors to
+    diag(R, I) with no cross-talk).  For tall X the appended unit columns
+    live entirely in the appended rows, so the padded gram is diag(XᵀX, I):
+    full column rank is preserved and a least-squares solve against
+    zero-padded RHS rows returns the original solution in X[:n].  Requires
+    rows - m >= cols - n (enough new rows to host the new columns' ones).
+    Pure iota masking like everything here — fuses, shard-transparent."""
+    m, n = X.shape
+    if rows < m or cols < n or rows - m < cols - n:
+        raise ValueError(
+            f"cannot embed {X.shape} into ({rows}, {cols}): need "
+            f"rows >= {m} and rows - {m} >= cols - {n}"
+        )
+    if (rows, cols) == (m, n):
+        return X
+    Xp = jnp.pad(X, ((0, rows - m), (0, cols - n)))
+    r = jnp.arange(rows)[:, None]
+    c = jnp.arange(cols)[None, :]
+    tail = (r - m == c - n) & (c >= n)
+    return Xp + tail.astype(X.dtype)
+
+
 def with_unit_diagonal(A: jnp.ndarray) -> jnp.ndarray:
     """Force ones on the diagonal (trmm/trsm 'Diag::AblasUnit' support,
     reference blas::Diag, engine.h:23-52)."""

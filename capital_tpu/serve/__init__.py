@@ -1,0 +1,21 @@
+"""capital_tpu.serve — the solve engine that turns the factorizations into
+a service (docs/SERVING.md).
+
+    from capital_tpu import serve
+
+    eng = serve.SolveEngine(grid, serve.ServeConfig(robust=RobustConfig()))
+    eng.warmup([("posv", (500, 500), (500, 4), "float32")])
+    ticket = eng.submit("posv", A, B)
+    eng.pump()            # deadline flushes (or: capacity flushes happen
+    resp = eng.drain() or ticket.result()   # inside submit)
+
+Smoke workload + gates: ``python -m capital_tpu.serve smoke`` /
+``make serve-smoke``.
+"""
+
+from capital_tpu.serve.engine import (  # noqa: F401
+    Response,
+    ServeConfig,
+    SolveEngine,
+    Ticket,
+)

@@ -1,0 +1,186 @@
+"""CLI: ``python -m capital_tpu.serve smoke ...`` — the serving self-check.
+
+Runs a small mixed-bucket workload on the local platform (CPU in CI),
+writes one serve:request_stats ledger record, and gates on the two
+acceptance properties of docs/SERVING.md:
+
+* **zero recompiles**: after warmup over the workload's >= 3 shape
+  buckets, every request-driven executable lookup must hit
+  (cache misses == 0, hit_rate == 1.0);
+* **numerics**: the max per-request residual stays under the pinned
+  dtype gate (bench/drivers._tolerance; the lstsq normal-equation
+  residual gets the same 10x allowance the qr drivers use — the gram
+  squares the conditioning).
+
+`make serve-smoke` runs this followed by ``obs serve-report
+--min-hit-rate 1.0`` over the written ledger, and `make audit` includes
+it in the CI self-checks.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import jax
+
+
+def _workload(requests: int, seed: int):
+    """Deterministic mixed workload touching >= 3 n-buckets, all three ops,
+    and two nrhs buckets."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    ns = (12, 24, 48, 16, 30, 64)  # -> buckets 16 / 32 / 64
+    ks = (1, 3)  # -> nrhs buckets 1 / 4
+    # 5-long op cycle against the 6-long n cycle (coprime) so blocks sweep
+    # the bucket grid; requests arrive in blocks of 4 IDENTICAL shapes
+    # (j = i // 4) so the capacity flush path sees full batches, while the
+    # pump() cadence below (every 7 submissions, coprime with 4) still
+    # catches partial blocks on the deadline path
+    ops = ("posv", "inv", "lstsq", "posv", "lstsq")
+    out = []
+    for i in range(requests):
+        j = i // 4
+        op = ops[j % len(ops)]
+        n = ns[j % len(ns)]
+        k = ks[j % len(ks)]
+        if op == "lstsq":
+            m = 4 * n
+            A = rng.standard_normal((m, n))
+            B = rng.standard_normal((m, k))
+        else:
+            M = rng.standard_normal((n, n))
+            A = M @ M.T / n + 3.0 * np.eye(n)
+            B = rng.standard_normal((n, k)) if op == "posv" else None
+        out.append((op, A, B))
+    return out
+
+
+def _residual(op: str, A, B, x) -> float:
+    import numpy as np
+
+    A = np.asarray(A, dtype=np.float64)
+    x = np.asarray(x, dtype=np.float64)
+    if op == "inv":
+        n = A.shape[0]
+        return float(np.linalg.norm(A @ x - np.eye(n)) / np.sqrt(n))
+    B = np.asarray(B, dtype=np.float64)
+    if op == "posv":
+        return float(np.linalg.norm(A @ x - B) / np.linalg.norm(B))
+    r = A.T @ (A @ x - B)
+    return float(np.linalg.norm(r) / np.linalg.norm(A.T @ B))
+
+
+def _smoke(args) -> int:
+    import jax.numpy as jnp
+
+    from capital_tpu.bench.drivers import _tolerance
+    from capital_tpu.serve import ServeConfig, SolveEngine
+
+    dtype = jnp.dtype(args.dtype)
+    cfg = ServeConfig(
+        buckets=(16, 32, 64),
+        rows_buckets=(64, 128, 256),
+        nrhs_buckets=(1, 4),
+        max_batch=4,
+        max_delay_s=0.01,
+    )
+    eng = SolveEngine(cfg=cfg)
+    work = _workload(args.requests, args.seed)
+    compiles = eng.warmup(
+        (op, A.shape, B.shape if B is not None else None, dtype)
+        for op, A, B in work
+    )
+    print(f"# serve-smoke: warmup compiled {compiles} executables")
+
+    tickets = []
+    for i, (op, A, B) in enumerate(work):
+        A = jnp.asarray(A, dtype=dtype)
+        B = jnp.asarray(B, dtype=dtype) if B is not None else None
+        tickets.append(eng.submit(op, A, B))
+        if i % 7 == 6:
+            # let the oldest queue age past the deadline so the max-delay
+            # flush path runs in the smoke, not only the capacity path
+            time.sleep(cfg.max_delay_s)
+            eng.pump()
+    eng.drain()
+
+    failures = []
+    tol = _tolerance(dtype)
+    worst: dict[str, float] = {}
+    buckets_seen = set()
+    for (op, A, B), t in zip(work, tickets):
+        r = t.result()
+        if not r.ok or r.x is None:
+            failures.append(f"request {r.request_id} ({op}) failed: {r.error}")
+            continue
+        if r.bucket is not None:
+            buckets_seen.add(r.bucket[:3])  # (op, dtype, a_shape)
+        res = _residual(op, A, B, r.x)
+        worst[op] = max(worst.get(op, 0.0), res)
+        gate = 10 * tol if op == "lstsq" else tol
+        if res >= gate:
+            failures.append(
+                f"request {r.request_id} ({op} {A.shape}) residual "
+                f"{res:.3e} >= {gate:.0e}"
+            )
+    cache = eng.cache_stats()
+    n_buckets = len({b[2] for b in buckets_seen})
+    rec = eng.emit_stats(
+        args.ledger,
+        smoke={
+            "max_residual": {k: round(v, 12) for k, v in worst.items()},
+            "distinct_bucket_shapes": n_buckets,
+            "residual_tol": tol,
+        },
+    )
+    print(json.dumps(rec["request_stats"]))
+    for op, v in sorted(worst.items()):
+        print(f"# serve-smoke: max {op} residual {v:.3e}")
+    if n_buckets < 3:
+        failures.append(
+            f"workload touched only {n_buckets} bucket shapes (< 3)"
+        )
+    if cache["misses"] or not cache["hits"]:
+        failures.append(
+            f"steady-state recompile: cache {cache} (expected misses == 0 "
+            "after warmup)"
+        )
+    for f in failures:
+        print(f"# serve-smoke FAIL: {f}", file=sys.stderr)
+    if failures:
+        return 1
+    print(
+        f"# serve-smoke OK: {len(tickets)} requests, hit_rate "
+        f"{cache['hit_rate']:.2f} over {cache['hits']} lookups, "
+        f"{n_buckets} bucket shapes"
+    )
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="capital_tpu.serve")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    s = sub.add_parser("smoke", help="mixed-bucket serving self-check")
+    s.add_argument("--requests", type=int, default=50)
+    s.add_argument("--dtype", default="float32")
+    s.add_argument("--seed", type=int, default=0)
+    s.add_argument("--ledger", default=None,
+                   help="append the request_stats record to this JSONL file")
+    s.add_argument("--platform", default=None)
+    s.set_defaults(fn=_smoke)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if getattr(args, "platform", None):
+        jax.config.update("jax_platforms", args.platform)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,149 @@
+"""The served solve kernels: posv / lstsq / inv, batched and single-problem.
+
+Two routes per op, chosen by the engine:
+
+* **batched** — a vmap over per-problem kernels built directly on the
+  LAPACK seam (ops/lapack) and lax.linalg, which batch natively.  The
+  models/ schedules are NOT vmapped: they carry sharding constraints and
+  trace-time cost-model emits sized for one distributed problem, neither of
+  which means anything replicated over a batch axis.  The batched kernels
+  are the same math at the same >= f32 compute-dtype discipline:
+
+      posv   potrf(A) + the two-trsm potrs sweeps        (lapack.potrs)
+      lstsq  CholeskyQR2 on the gram + triangular solve  (the CQR2 pipeline
+             of models/qr.py collapsed to its single-problem form)
+      inv    potrf_trtri + R⁻¹·R⁻ᵀ                       (spd_inverse's core)
+
+  Every batched kernel returns (X, info) with info the per-problem int32
+  breakdown status (robust/detect via lapack's with_info paths) — detection
+  is O(n²) against the O(n³) solve, so it is always on; the engine decides
+  whether to surface it (ServeConfig.robust) or let NaNs pass like the raw
+  lax paths would.
+
+* **single** — oversize requests (beyond every bucket ladder) run unbatched
+  through the REAL models/ paths (cholesky.solve, qr.factor + triangular
+  solve, cholinv factor + SUMMA gemm), so a giant request still gets the
+  distributed schedules and, under robust, the full shifted-CholeskyQR
+  recovery rather than detect-only flagging.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from capital_tpu.models import cholesky, qr
+from capital_tpu.ops import lapack
+from capital_tpu.parallel import summa
+from capital_tpu.utils import tracing
+
+
+def _tri_solve_upper(R, B, precision):
+    """R·X = B for upper-triangular R at the >= f32 compute dtype."""
+    del precision  # triangular_solve has no precision knob; upcast covers it
+    ct = lapack._compute_dtype(R.dtype)
+    X = lax.linalg.triangular_solve(
+        R.astype(ct), B.astype(ct), left_side=True, lower=False
+    )
+    return X.astype(B.dtype)
+
+
+def _one_posv(precision):
+    def f(a, b):
+        with tracing.scope("serve::solve"):
+            R, info = lapack.potrf(a, uplo="U", with_info=True)
+            return lapack.potrs(R, b, uplo="U"), info
+
+    return f
+
+
+def _one_lstsq(precision):
+    def f(a, b):
+        with tracing.scope("serve::solve"):
+            # CQR2 (models/qr.py single-problem form): two gram-Cholesky
+            # sweeps; Q = A·R1⁻¹·R2⁻¹, R = R2·R1; then solve R·X = QᵀB.
+            g = jnp.matmul(a.T, a, precision=precision)
+            r1, r1i, i1 = lapack.potrf_trtri(g, uplo="U", with_info=True)
+            q1 = jnp.matmul(a, jnp.triu(r1i), precision=precision)
+            g2 = jnp.matmul(q1.T, q1, precision=precision)
+            r2, r2i, i2 = lapack.potrf_trtri(g2, uplo="U", with_info=True)
+            R = jnp.matmul(jnp.triu(r2), jnp.triu(r1), precision=precision)
+            qtb = jnp.matmul(
+                jnp.triu(r2i).T,
+                jnp.matmul(q1.T, b, precision=precision),
+                precision=precision,
+            )
+            return _tri_solve_upper(R, qtb, precision), jnp.maximum(i1, i2)
+
+    return f
+
+
+def _one_inv(precision):
+    def f(a):
+        with tracing.scope("serve::solve"):
+            _, rinv, info = lapack.potrf_trtri(a, uplo="U", with_info=True)
+            tri = jnp.triu(rinv)
+            return jnp.matmul(tri, tri.T, precision=precision), info
+
+    return f
+
+
+def batched(op: str, precision: str | None = "highest"):
+    """The function the engine AOT-compiles for one bucket: maps the fixed
+    (capacity, *problem) batch through the per-problem kernel, returning
+    (X, info) stacks."""
+    if op == "inv":
+        return jax.vmap(_one_inv(precision))
+    one = {"posv": _one_posv, "lstsq": _one_lstsq}[op](precision)
+    return jax.vmap(one)
+
+
+def single(op: str, grid, precision: str | None = "highest", robust=None):
+    """The oversize route: one exact-shape problem through the models/
+    schedules on the engine's grid.  Uniform return contract (X, info):
+    info is a scalar int32 (posv/inv) or a RobustInfo pytree (lstsq under
+    robust); jnp.int32(0) when robust is None (the engine ignores it then).
+    """
+    if op == "posv":
+        ccfg = cholesky.CholinvConfig(precision=precision, robust=robust)
+
+        def f(a, b):
+            out = cholesky.solve(grid, a, b, ccfg)
+            return out if robust is not None else (out, jnp.int32(0))
+
+        return f
+    if op == "lstsq":
+        qcfg = qr.CacqrConfig(
+            precision=precision, robust=robust,
+            cholinv=cholesky.CholinvConfig(precision=precision),
+        )
+
+        def f(a, b):
+            out = qr.factor(grid, a, qcfg)
+            if robust is not None:
+                Q, R, rinfo = out
+            else:
+                (Q, R), rinfo = out, jnp.int32(0)
+            qtb = qr.apply_QT(grid, Q, b, precision=precision)
+            return _tri_solve_upper(R, qtb, precision), rinfo
+
+        return f
+    if op == "inv":
+        ccfg = cholesky.CholinvConfig(precision=precision, robust=robust)
+
+        def f(a):
+            if robust is not None:
+                _, rinv, info = cholesky.factor(grid, a, ccfg)
+            else:
+                _, rinv = cholesky.factor(grid, a, ccfg)
+                info = jnp.int32(0)
+            ainv = summa.gemm(
+                grid, rinv, rinv,
+                args=summa.GemmArgs(trans_b=True, precision=precision),
+                mode=ccfg.mode,
+            )
+            return ainv, info
+
+        return f
+    raise ValueError(f"unknown serve op {op!r}")

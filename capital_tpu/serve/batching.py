@@ -1,0 +1,149 @@
+"""Shape bucketing + micro-batch assembly for the solve engine.
+
+Every distinct operand shape is a fresh trace + compile; served traffic with
+free-form shapes would recompile forever.  The classic serving answer
+(bucketed paddings — the same trick XLA serving stacks use for sequence
+lengths) applies cleanly to the CAPITAL solves because the repo already owns
+a *structure-safe* pad: `masking.embed_identity_tail` generalizes
+cholesky.pad_embed_identity's diag(X, I) embed, so a padded SPD matrix stays
+SPD (factors to diag(R, I)) and a padded tall operand keeps full column rank
+(the appended unit columns live in appended rows).  Padded right-hand sides
+are zero-filled, so the identity tail solves to exact zeros and cropping
+recovers the original solution bit-for-bit in exact arithmetic.
+
+A `Bucket` is the padded per-problem shape plus the batch capacity; the
+engine compiles ONE executable per bucket at the fixed batch shape
+(capacity, *problem) and short batches are topped up with benign identity
+fill problems — fixed shapes are the whole point (a dynamic batch dimension
+would reintroduce one compile per batch size).
+
+This module is policy-free about ladders: `bucket_for` reads them from the
+engine's ServeConfig (duck-typed: .buckets / .rows_buckets / .nrhs_buckets /
+.max_batch) so batching never imports engine.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from capital_tpu.ops import masking
+from capital_tpu.utils import tracing
+
+OPS = ("posv", "lstsq", "inv")
+
+
+@dataclasses.dataclass(frozen=True)
+class Bucket:
+    """One executable-cache shape class: the padded per-problem operand
+    shapes plus the micro-batch capacity.  Hashable (dict key for the
+    executable cache and the per-bucket queues)."""
+
+    op: str
+    dtype: str
+    a_shape: tuple[int, ...]
+    b_shape: tuple[int, ...] | None
+    capacity: int
+
+    @property
+    def key(self) -> tuple:
+        return (self.op, self.dtype, self.a_shape, self.b_shape,
+                self.capacity)
+
+
+def _pick(ladder: tuple[int, ...], v: int) -> int | None:
+    """Smallest ladder rung >= v, or None (oversize)."""
+    best = None
+    for r in ladder:
+        if r >= v and (best is None or r < best):
+            best = r
+    return best
+
+
+def bucket_for(op: str, a_shape, b_shape, dtype: str, cfg) -> Bucket | None:
+    """Resolve a request's operand shapes to a bucket, or None when any
+    dimension exceeds its ladder (the engine then routes the request
+    unbatched through the models/ paths — `oversize` policy).
+
+    lstsq rows bucket at `m + (nb - n)`: the column pad appends one unit
+    column PER padded column and each needs its own appended row
+    (masking.embed_identity_tail's rows - m >= cols - n contract)."""
+    if op not in OPS:
+        raise ValueError(f"unknown serve op {op!r}; expected one of {OPS}")
+    if op in ("posv", "inv"):
+        n = a_shape[0]
+        nb = _pick(cfg.buckets, n)
+        if nb is None:
+            return None
+        if op == "inv":
+            return Bucket(op, dtype, (nb, nb), None, cfg.max_batch)
+        kb = _pick(cfg.nrhs_buckets, b_shape[1])
+        if kb is None:
+            return None
+        return Bucket(op, dtype, (nb, nb), (nb, kb), cfg.max_batch)
+    m, n = a_shape
+    nb = _pick(cfg.buckets, n)
+    if nb is None:
+        return None
+    mb = _pick(cfg.rows_buckets, m + (nb - n))
+    kb = _pick(cfg.nrhs_buckets, b_shape[1])
+    if mb is None or kb is None:
+        return None
+    return Bucket(op, dtype, (mb, nb), (mb, kb), cfg.max_batch)
+
+
+def pad_operands(op: str, A, B, bucket: Bucket):
+    """Pad one request's concrete operands to the bucket's per-problem
+    shapes: identity-tail embed for the factored operand, zero-fill for the
+    RHS.  Host-side eager (submit time), tagged serve::pad so profiler
+    traces attribute the pad cost to the serving layer."""
+    with tracing.scope("serve::pad"):
+        pa = masking.embed_identity_tail(A, *bucket.a_shape)
+        pb = None
+        if bucket.b_shape is not None:
+            m, k = B.shape
+            pb = jnp.pad(
+                B, ((0, bucket.b_shape[0] - m), (0, bucket.b_shape[1] - k))
+            )
+        return pa, pb
+
+
+def fill_problem(bucket: Bucket):
+    """The benign problem that tops a short batch up to capacity: an
+    identity operand (SPD for posv/inv, orthonormal columns for lstsq —
+    its gram is I, so every op factors it cleanly) against a zero RHS."""
+    dt = jnp.dtype(bucket.dtype)
+    fa = jnp.eye(*bucket.a_shape, dtype=dt)
+    fb = None
+    if bucket.b_shape is not None:
+        fb = jnp.zeros(bucket.b_shape, dtype=dt)
+    return fa, fb
+
+
+def assemble(padded_a, padded_b, bucket: Bucket):
+    """Stack per-request padded operands into the bucket's fixed batch
+    shape, topping up with fill problems.  Returns (Ab, Bb | None,
+    occupancy) — occupancy is the real-request fraction of capacity, the
+    number stats.py reports (chronically low occupancy means the flush
+    policy or the ladder is mis-tuned)."""
+    nreq = len(padded_a)
+    if not 0 < nreq <= bucket.capacity:
+        raise ValueError(f"{nreq} requests for capacity {bucket.capacity}")
+    fa, fb = fill_problem(bucket)
+    Ab = jnp.stack(list(padded_a) + [fa] * (bucket.capacity - nreq))
+    Bb = None
+    if bucket.b_shape is not None:
+        Bb = jnp.stack(list(padded_b) + [fb] * (bucket.capacity - nreq))
+    return Ab, Bb, nreq / bucket.capacity
+
+
+def crop(op: str, X, a_shape, b_shape):
+    """Slice one padded per-problem solution back to the request's true
+    shape (the unpad half of the masking contract: the identity tail's
+    rows of X are exact zeros and are dropped here)."""
+    if op == "posv":
+        return X[: a_shape[0], : b_shape[1]]
+    if op == "lstsq":
+        return X[: a_shape[1], : b_shape[1]]
+    return X[: a_shape[0], : a_shape[0]]

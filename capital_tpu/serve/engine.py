@@ -1,0 +1,409 @@
+"""SolveEngine: the AOT-cached, shape-bucketed, micro-batching solve service.
+
+The serving loop the ROADMAP's "heavy traffic" north star needs, built from
+what PRs 1-3 already provide (docs/SERVING.md has the full lifecycle):
+
+* **AOT executable cache** — every program the engine runs is compiled once
+  via ``jax.jit(fn).lower(ShapeDtypeStruct...).compile()`` (the aot65536
+  pattern) and cached under an explicit key (op, dtype, shape-bucket,
+  mesh/topology, config-hash).  Hit/miss counters make "steady-state traffic
+  hits zero recompiles" an *assertable* property, not a hope: `warmup()`
+  pre-compiles the bucket ladder without touching the counters, after which
+  a clean run shows misses == 0 / hit_rate == 1.0 (tests/test_serve.py,
+  `make serve-smoke`).
+
+* **Shape bucketing + micro-batching** — requests pad to bucket ladders
+  (serve/batching.py) and queue per bucket; a batch flushes when it reaches
+  `max_batch` (at submit) or when its oldest request ages past `max_delay_s`
+  (at `pump()`/`drain()`).  Oversize requests bypass batching and run
+  through the real models/ schedules, AOT-cached per exact shape.
+
+* **Robust routing** — with ServeConfig.robust, each response carries a
+  RobustInfo and a breakdown flags ONE request (`ok=False`) instead of
+  killing the engine; fault injection enters host-side at the
+  ``serve::ingest`` tap on the concrete per-request operand, so a planted
+  fault can never bake into a cached executable (the trace-time-tap hazard
+  faultinject's docstring warns about).
+
+* **Donation** — batched RHS / operand buffers are donated on TPU only
+  (ServeConfig.donate=None auto): CPU's runtime ignores donation with a
+  warning per executable, and the engine builds those batch arrays itself
+  so donating them is always safe.  The single-problem models route never
+  donates: schedules like cholinv's schur_in_place carry their own aliasing
+  contracts on caller buffers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from capital_tpu.parallel.topology import Grid
+from capital_tpu.robust import faultinject
+from capital_tpu.robust.config import RobustConfig, RobustInfo
+from capital_tpu.serve import api, batching, stats
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Engine policy knobs.
+
+    buckets: the n ladder (SPD dimension / lstsq columns).
+    rows_buckets: the lstsq m ladder (requests bucket at m + column-pad).
+    nrhs_buckets: the RHS-columns ladder.
+    max_batch: per-bucket batch capacity — one executable per bucket at
+        this fixed batch size; also the submit-time flush threshold.
+    max_delay_s: oldest-request age that forces a flush at pump() — the
+        latency bound a half-full batch is allowed to cost.
+    precision: matmul precision inside the kernels ('highest' matches the
+        models/ defaults; see CholinvConfig.precision).
+    robust: attach per-request breakdown flagging (batched: detect-only;
+        oversize lstsq: the full shifted-CholeskyQR recovery).
+    donate: donate engine-built batch inputs to their executables; None =
+        auto (TPU yes, CPU no — the CPU runtime warns and ignores).
+    oversize: 'models' routes beyond-ladder requests through the unbatched
+        models/ paths; 'reject' fails them (a hard-real-time posture where
+        an unexpected compile is worse than an error).
+    """
+
+    buckets: tuple[int, ...] = (256, 512, 1024)
+    rows_buckets: tuple[int, ...] = (4096, 16384, 65536)
+    nrhs_buckets: tuple[int, ...] = (1, 8, 64)
+    max_batch: int = 8
+    max_delay_s: float = 0.005
+    precision: Optional[str] = "highest"
+    robust: Optional[RobustConfig] = None
+    donate: Optional[bool] = None
+    oversize: str = "models"
+
+
+@dataclasses.dataclass
+class Response:
+    """One finished request.  `x` is the cropped solution (None only when
+    `ok` is False with `error` set — an ingest fault or a rejected
+    request).  `info` is a RobustInfo under ServeConfig.robust (breakdown
+    != 0 means x is flagged garbage), else None."""
+
+    request_id: int
+    op: str
+    ok: bool
+    x: Optional[jnp.ndarray]
+    info: Optional[RobustInfo]
+    error: Optional[str]
+    bucket: Optional[tuple]
+    batched: bool
+    latency_s: float
+
+
+class Ticket:
+    """Handle returned by submit(); resolves when its batch flushes."""
+
+    __slots__ = ("request_id", "response")
+
+    def __init__(self, request_id: int):
+        self.request_id = request_id
+        self.response: Optional[Response] = None
+
+    @property
+    def done(self) -> bool:
+        return self.response is not None
+
+    def result(self) -> Response:
+        if self.response is None:
+            raise RuntimeError(
+                f"request {self.request_id} not flushed yet — call "
+                "engine.pump() (deadline flush) or engine.drain()"
+            )
+        return self.response
+
+
+@dataclasses.dataclass
+class _Pending:
+    ticket: Ticket
+    pa: jnp.ndarray
+    pb: Optional[jnp.ndarray]
+    a_shape: tuple[int, ...]
+    b_shape: Optional[tuple[int, ...]]
+    t_enq: float
+
+
+class SolveEngine:
+    """See module docstring.  One engine per (grid, ServeConfig); not
+    thread-safe (a single dispatch loop owns it, like a jax program)."""
+
+    def __init__(self, grid: Optional[Grid] = None,
+                 cfg: ServeConfig = ServeConfig()):
+        if cfg.oversize not in ("models", "reject"):
+            raise ValueError(f"unknown oversize policy {cfg.oversize!r}")
+        self.grid = grid or Grid.square(c=1, devices=jax.devices()[:1])
+        self.cfg = cfg
+        self.stats = stats.Collector()
+        self._exe: dict[tuple, object] = {}
+        self._queues: dict[batching.Bucket, list[_Pending]] = {}
+        self._hits = 0
+        self._misses = 0
+        self._warmup_compiles = 0
+        self._next_id = 0
+        # config-hash: everything that changes the compiled programs or the
+        # padding geometry — two engines differing here must never share
+        # cache entries, and the key makes that structural.
+        ident = repr((cfg.buckets, cfg.rows_buckets, cfg.nrhs_buckets,
+                      cfg.max_batch, cfg.precision, cfg.robust))
+        self._cfg_hash = hashlib.sha1(ident.encode()).hexdigest()[:12]
+        self._grid_key = (self.grid.dx, self.grid.dy, self.grid.c,
+                          self.grid.platform)
+
+    # ---- cache -------------------------------------------------------------
+
+    def _donate(self) -> bool:
+        d = self.cfg.donate
+        return self.grid.platform == "tpu" if d is None else d
+
+    def _get_batched(self, bucket: batching.Bucket, warmup: bool = False):
+        key = ("batch", bucket.key, self._grid_key, self._cfg_hash)
+        exe = self._exe.get(key)
+        if exe is not None:
+            if not warmup:
+                self._hits += 1
+            return exe
+        if warmup:
+            self._warmup_compiles += 1
+        else:
+            self._misses += 1
+        dt = jnp.dtype(bucket.dtype)
+        specs = [jax.ShapeDtypeStruct((bucket.capacity,) + bucket.a_shape, dt)]
+        dn: tuple[int, ...] = ()
+        if bucket.b_shape is not None:
+            specs.append(
+                jax.ShapeDtypeStruct((bucket.capacity,) + bucket.b_shape, dt)
+            )
+            if self._donate():
+                dn = (1,)  # the RHS batch: posv aliases it shape-for-shape
+        elif self._donate():
+            dn = (0,)  # inv: the operand batch aliases the inverse batch
+        fn = api.batched(bucket.op, self.cfg.precision)
+        exe = jax.jit(fn, donate_argnums=dn).lower(*specs).compile()
+        self._exe[key] = exe
+        return exe
+
+    def _get_single(self, op: str, a_sds, b_sds, warmup: bool = False):
+        key = ("single", op, str(a_sds.dtype), a_sds.shape,
+               b_sds.shape if b_sds is not None else None,
+               self._grid_key, self._cfg_hash)
+        exe = self._exe.get(key)
+        if exe is not None:
+            if not warmup:
+                self._hits += 1
+            return exe
+        if warmup:
+            self._warmup_compiles += 1
+        else:
+            self._misses += 1
+        fn = api.single(op, self.grid, self.cfg.precision, self.cfg.robust)
+        specs = (a_sds,) if b_sds is None else (a_sds, b_sds)
+        exe = jax.jit(fn).lower(*specs).compile()
+        self._exe[key] = exe
+        return exe
+
+    def cache_stats(self) -> dict:
+        """Hit/miss counters over request-driven executable lookups.
+        warmup() compiles count separately — hit_rate measures steady-state
+        traffic, and the acceptance gate is hit_rate == 1.0 after warmup."""
+        lookups = self._hits + self._misses
+        return {
+            "hits": self._hits,
+            "misses": self._misses,
+            "warmup_compiles": self._warmup_compiles,
+            "entries": len(self._exe),
+            "hit_rate": (self._hits / lookups) if lookups else 1.0,
+        }
+
+    def warmup(self, specs) -> int:
+        """Pre-compile executables for example request shapes.  `specs` is
+        an iterable of (op, a_shape, b_shape, dtype) — b_shape None for
+        inv.  Shapes resolve through the SAME bucket ladder as submit(),
+        so warming one representative per bucket covers every shape that
+        maps there; oversize shapes warm their exact-shape single route.
+        Returns the number of fresh compiles."""
+        before = self._warmup_compiles
+        for op, a_shape, b_shape, dtype in specs:
+            dt = jnp.dtype(dtype)
+            bucket = batching.bucket_for(
+                op, tuple(a_shape), tuple(b_shape) if b_shape else None,
+                str(dt), self.cfg,
+            )
+            if bucket is not None:
+                self._get_batched(bucket, warmup=True)
+            elif self.cfg.oversize == "models":
+                a_sds = jax.ShapeDtypeStruct(tuple(a_shape), dt)
+                b_sds = (jax.ShapeDtypeStruct(tuple(b_shape), dt)
+                         if b_shape else None)
+                self._get_single(op, a_sds, b_sds, warmup=True)
+        return self._warmup_compiles - before
+
+    # ---- request path ------------------------------------------------------
+
+    def submit(self, op: str, A, B=None) -> Ticket:
+        """Enqueue one solve request; returns a Ticket that resolves when
+        its batch flushes (possibly within this call: capacity flush, or
+        immediately for oversize requests)."""
+        t0 = time.monotonic()
+        tid = self._next_id
+        self._next_id += 1
+        ticket = Ticket(tid)
+        A = jnp.asarray(A)
+        B = jnp.asarray(B) if B is not None else None
+        if op not in batching.OPS:
+            raise ValueError(
+                f"unknown serve op {op!r}; expected one of {batching.OPS}"
+            )
+        if op in ("posv", "lstsq") and (B is None or B.ndim != 2
+                                        or B.shape[0] != A.shape[0]):
+            raise ValueError(
+                f"{op} needs a 2D RHS with {A.shape[0]} rows, got "
+                f"{None if B is None else B.shape}"
+            )
+        if op in ("posv", "inv") and A.shape[0] != A.shape[1]:
+            raise ValueError(f"{op} needs a square SPD operand, got {A.shape}")
+        if op == "lstsq" and A.shape[0] < A.shape[1]:
+            raise ValueError(f"lstsq expects tall input, got {A.shape}")
+        try:
+            # HOST-side per-request fault tap on the concrete operand:
+            # deterministic per submit() occurrence, and — critically —
+            # never part of a traced program, so a fault corrupts exactly
+            # one request and leaves the executable cache clean.
+            A = faultinject.tap(A, point="serve::ingest")
+        except faultinject.FaultInjected as e:
+            self._fail(ticket, op, str(e), t0)
+            return ticket
+        bucket = batching.bucket_for(
+            op, A.shape, B.shape if B is not None else None,
+            str(A.dtype), self.cfg,
+        )
+        if bucket is None:
+            if self.cfg.oversize == "reject":
+                self._fail(
+                    ticket, op,
+                    f"no bucket for {op} {A.shape} and oversize='reject'",
+                    t0,
+                )
+            else:
+                self._run_single(ticket, op, A, B, t0)
+            return ticket
+        pa, pb = batching.pad_operands(op, A, B, bucket)
+        q = self._queues.setdefault(bucket, [])
+        q.append(_Pending(
+            ticket, pa, pb, tuple(A.shape),
+            tuple(B.shape) if B is not None else None, t0,
+        ))
+        self.stats.note_queue_depth(self.queue_depth())
+        if len(q) >= bucket.capacity:
+            self._flush(bucket)
+        return ticket
+
+    def pump(self, now: Optional[float] = None) -> int:
+        """Deadline flush: run every bucket whose oldest request has aged
+        past max_delay_s.  Call from the dispatch loop between submits;
+        returns the number of batches flushed."""
+        now = time.monotonic() if now is None else now
+        flushed = 0
+        for bucket in list(self._queues):
+            q = self._queues.get(bucket)
+            if q and now - q[0].t_enq >= self.cfg.max_delay_s:
+                self._flush(bucket)
+                flushed += 1
+        return flushed
+
+    def drain(self) -> int:
+        """Flush every non-empty queue regardless of age (shutdown / test
+        barrier).  Returns the number of batches flushed."""
+        flushed = 0
+        for bucket in list(self._queues):
+            if self._queues.get(bucket):
+                self._flush(bucket)
+                flushed += 1
+        return flushed
+
+    def solve(self, op: str, A, B=None) -> Response:
+        """Convenience synchronous path: submit + drain + result."""
+        ticket = self.submit(op, A, B)
+        if not ticket.done:
+            self.drain()
+        return ticket.result()
+
+    def queue_depth(self) -> int:
+        return sum(len(q) for q in self._queues.values())
+
+    def emit_stats(self, path: Optional[str] = None, **extra) -> dict:
+        """Snapshot telemetry + cache counters into one serve:request_stats
+        ledger record (appended to `path` when given)."""
+        return self.stats.emit(
+            path, grid=self.grid, config=self.cfg,
+            cache=self.cache_stats(), **extra,
+        )
+
+    # ---- internals ---------------------------------------------------------
+
+    def _fail(self, ticket: Ticket, op: str, error: str, t0: float) -> None:
+        lat = time.monotonic() - t0
+        ticket.response = Response(
+            request_id=ticket.request_id, op=op, ok=False, x=None,
+            info=None, error=error, bucket=None, batched=False,
+            latency_s=lat,
+        )
+        self.stats.record_request(op, lat, ok=False, failed=True)
+
+    def _norm_info(self, raw) -> Optional[RobustInfo]:
+        if self.cfg.robust is None:
+            return None
+        if isinstance(raw, RobustInfo):
+            return RobustInfo(
+                info=int(raw.info), breakdown=int(raw.breakdown),
+                shifted=int(raw.shifted), sigma=float(raw.sigma),
+                escalated=int(raw.escalated), ortho=float(raw.ortho),
+            )
+        i = int(raw)
+        # detect-only sites surface the potrf convention; no recovery ran
+        return RobustInfo(info=i, breakdown=int(i != 0), shifted=0,
+                          sigma=0.0, escalated=0, ortho=-1.0)
+
+    def _finish(self, ticket: Ticket, op: str, x, raw_info,
+                bucket_key: Optional[tuple], batched: bool,
+                t0: float) -> None:
+        info = self._norm_info(raw_info)
+        ok = info is None or info.info == 0
+        lat = time.monotonic() - t0
+        ticket.response = Response(
+            request_id=ticket.request_id, op=op, ok=ok, x=x, info=info,
+            error=None, bucket=bucket_key, batched=batched, latency_s=lat,
+        )
+        self.stats.record_request(op, lat, ok=ok,
+                                  flagged=(info is not None and not ok))
+
+    def _flush(self, bucket: batching.Bucket) -> None:
+        q = self._queues.pop(bucket, [])
+        if not q:
+            return
+        exe = self._get_batched(bucket)
+        Ab, Bb, occupancy = batching.assemble(
+            [p.pa for p in q], [p.pb for p in q], bucket,
+        )
+        X, info = exe(Ab) if Bb is None else exe(Ab, Bb)
+        self.stats.note_batch(occupancy)
+        for i, p in enumerate(q):
+            xi = batching.crop(bucket.op, X[i], p.a_shape, p.b_shape)
+            self._finish(p.ticket, bucket.op, xi, info[i], bucket.key,
+                         True, p.t_enq)
+
+    def _run_single(self, ticket: Ticket, op: str, A, B, t0: float) -> None:
+        a_sds = jax.ShapeDtypeStruct(A.shape, A.dtype)
+        b_sds = (jax.ShapeDtypeStruct(B.shape, B.dtype)
+                 if B is not None else None)
+        exe = self._get_single(op, a_sds, b_sds)
+        x, raw = exe(A) if B is None else exe(A, B)
+        self._finish(ticket, op, x, raw, None, False, t0)

@@ -1,0 +1,110 @@
+"""Serving telemetry: latencies, queue depth, occupancy, cache hit-rate.
+
+One `Collector` per SolveEngine accumulates per-request and per-batch facts
+host-side (pure Python — nothing here touches a device) and snapshots them
+into a `request_stats` block: the schema_version-tagged record payload
+`obs.ledger` validates (ledger.validate_request_stats), `obs serve-report`
+summarizes, and `ledger.diff` exempts from the metric-regression check the
+same way event/robust records are exempt (a served mix's latency profile is
+workload, not a kernel regression).
+
+Latency percentiles come from bench/harness.percentiles — the same
+nearest-rank p50/p95/p99 the bench report lines carry, so a request_stats
+record and a bench row read on one scale.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+
+from capital_tpu.bench.harness import percentiles
+
+
+class Collector:
+    """Accumulates serving telemetry; snapshot() emits the request_stats
+    block documented in docs/SERVING.md."""
+
+    def __init__(self):
+        self.requests = 0
+        self.ok = 0
+        self.flagged = 0  # robust-flagged (breakdown detected, result kept)
+        self.failed = 0  # no result at all (ingest fault / rejected)
+        self.ops: Counter = Counter()
+        self.latencies_s: list[float] = []
+        self.queue_depth_max = 0
+        self.batches = 0
+        self.occupancies: list[float] = []
+
+    # ---- feeding -----------------------------------------------------------
+
+    def note_queue_depth(self, depth: int) -> None:
+        self.queue_depth_max = max(self.queue_depth_max, depth)
+
+    def note_batch(self, occupancy: float) -> None:
+        self.batches += 1
+        self.occupancies.append(occupancy)
+
+    def record_request(
+        self, op: str, latency_s: float, ok: bool,
+        flagged: bool = False, failed: bool = False,
+    ) -> None:
+        self.requests += 1
+        self.ops[op] += 1
+        self.latencies_s.append(latency_s)
+        if failed:
+            self.failed += 1
+        elif flagged:
+            self.flagged += 1
+        elif ok:
+            self.ok += 1
+
+    # ---- reporting ---------------------------------------------------------
+
+    def snapshot(self, cache: dict | None = None) -> dict:
+        """The request_stats block.  `cache` is the engine's cache_stats()
+        (hits/misses/hit_rate/warmup_compiles); zeros when absent so the
+        schema stays total."""
+        from capital_tpu.obs.ledger import SCHEMA_VERSION
+
+        lat = (
+            {k: round(v * 1e3, 4)
+             for k, v in percentiles(self.latencies_s).items()}
+            if self.latencies_s
+            else {"p50": 0.0, "p95": 0.0, "p99": 0.0}
+        )
+        occ = self.occupancies
+        return {
+            "schema_version": SCHEMA_VERSION,
+            "requests": self.requests,
+            "ok": self.ok,
+            "flagged": self.flagged,
+            "failed": self.failed,
+            "ops": dict(self.ops),
+            "latency_ms": lat,
+            "queue_depth_max": self.queue_depth_max,
+            "batches": self.batches,
+            "batch_occupancy_mean": (
+                round(sum(occ) / len(occ), 4) if occ else 0.0
+            ),
+            "cache": dict(cache) if cache else {
+                "hits": 0, "misses": 0, "warmup_compiles": 0,
+                "hit_rate": 1.0,
+            },
+        }
+
+    def emit(self, path: str | None, *, grid=None, config=None,
+             cache: dict | None = None, **extra) -> dict:
+        """Assemble (and append, when `path` is given) ONE ledger record
+        carrying the snapshot — kind 'serve:request_stats', same manifest
+        discipline as every other ledger row."""
+        from capital_tpu.obs import ledger
+
+        rec = ledger.record(
+            "serve:request_stats",
+            ledger.manifest(grid=grid, config=config),
+            request_stats=self.snapshot(cache),
+            **extra,
+        )
+        if path:
+            ledger.append(path, rec)
+        return rec

@@ -122,6 +122,13 @@ PHASE_REGISTRY: tuple[str, ...] = (
     "RT::batch_write",
     # trsm (trsm.py)
     "TS::dinv", "TS::leaf", "TS::update",
+    # serve (serve/, docs/SERVING.md).  serve::ingest is HOST-side — the
+    # per-request fault-injection tap fires on the concrete operand at
+    # submit(), never inside a traced program, so a planted fault corrupts
+    # exactly one request instead of baking into the AOT executable cache.
+    # serve::pad wraps bucket padding; serve::solve wraps the per-problem
+    # solve kernels inside the batched executables.
+    "serve::ingest", "serve::pad", "serve::solve",
 )
 _PHASE_SET: set[str] = set(PHASE_REGISTRY)
 

@@ -51,3 +51,11 @@ def grid2x2x1() -> Grid:
 def grid_flat8() -> Grid:
     """8x1x1 — the 1D tall-skinny topology."""
     return Grid.flat()
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 gate (pytest -m 'not slow'); "
+        "covered by `make audit` targets instead",
+    )

@@ -5,8 +5,8 @@
 
 PY ?= python
 
-.PHONY: all test benchmarking bench-explicit tune audit robust serve-smoke \
-	native clean
+.PHONY: all test benchmarking bench-explicit tune audit lint robust \
+	serve-smoke native clean
 
 all: test
 
@@ -36,10 +36,24 @@ tune:
 
 # model-vs-compiled drift gate on the flagship configs (docs/OBSERVABILITY.md);
 # compile-only — runs in CI without a TPU (exit non-zero on drift)
-audit: serve-smoke
+audit: serve-smoke lint
 	$(PY) -m capital_tpu.obs audit cholinv --n 4096 --platform cpu
 	$(PY) -m capital_tpu.obs audit cacqr --m 16384 --n 512 --platform cpu
 	$(PY) -m capital_tpu.obs robust-gate --platform cpu
+
+# static analysis gate (docs/STATIC_ANALYSIS.md): the program sanitizer over
+# the flagship cholinv/cacqr/serve-bucket entry points (phase coverage,
+# donation, cache-key hygiene, host sync, dtype drift, collective budget)
+# plus the AST source lint, each appending one lint:report ledger record
+# that `obs lint-report` re-gates — compile-only, no TPU needed
+lint:
+	rm -f lint_report.jsonl
+	$(PY) -m capital_tpu.lint program --platform cpu \
+		--ledger lint_report.jsonl
+	$(PY) -m capital_tpu.lint source capital_tpu \
+		--fail-on warn --ledger lint_report.jsonl
+	$(PY) -m capital_tpu.obs lint-report lint_report.jsonl \
+		--require-pass program --require-pass source
 
 # serving self-check (docs/SERVING.md): mixed-bucket CPU workload through
 # the SolveEngine, one serve:request_stats ledger record, gated on 100%
@@ -62,5 +76,6 @@ native:
 	$(PY) -c "from capital_tpu import native; print('native engine available:', native.available())"
 
 clean:
-	rm -rf autotune_out .pytest_cache bench_explicit.jsonl serve_smoke.jsonl
+	rm -rf autotune_out .pytest_cache bench_explicit.jsonl serve_smoke.jsonl \
+		lint_report.jsonl
 	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -24,6 +24,7 @@ Usage: python -m capital_tpu.bench <driver> [--n 4096 ...]
 from __future__ import annotations
 
 import argparse
+import logging
 import sys
 
 import jax
@@ -35,6 +36,8 @@ from capital_tpu.parallel import summa
 from capital_tpu.parallel.topology import Grid
 from capital_tpu.robust.config import RobustConfig
 from capital_tpu.utils import residual
+
+_log = logging.getLogger(__name__)
 
 
 def _tolerance(dtype) -> float:
@@ -93,8 +96,9 @@ def _ledger_append(
             model = ledger.model_costs(recd, dtype=dtype)
             audit_d = audit.asdict()
             drift_d = rep.asdict()
-        except Exception as e:  # noqa: BLE001 — ledger must not fail the run
+        except Exception as e:  # broad on purpose: ledger must not fail the run
             err = f"{type(e).__name__}: {e}"
+            _log.warning("ledger audit capture failed: %s", err)
     row = ledger.record(
         f"bench:{name}",
         ledger.manifest(grid=grid, dtype=dtype, config=cfg),
@@ -135,8 +139,10 @@ def _hbm_bytes() -> float:
         limit = float(stats.get("bytes_limit", 0))
         if limit > 1e9:
             return limit
-    except Exception:
-        pass
+    except Exception as e:
+        # runtimes without memory_stats fall through to the conservative
+        # default; keep the swallow visible for anything less expected
+        _log.debug("memory_stats unavailable: %s: %s", type(e).__name__, e)
     return 15.5e9
 
 

@@ -1,0 +1,21 @@
+"""capital_tpu.lint — jaxpr/HLO program sanitizer and repo source lint.
+
+Two passes over one rules engine (docs/STATIC_ANALYSIS.md):
+
+* ``lint.program`` — trace/compile any model or serve entry point and
+  verify the repo's runtime invariants statically: phase coverage, honored
+  donation, AOT-cache key hygiene, no host sync in hot paths, no dtype
+  drift, collective counts within the obs drift envelope.
+* ``lint.source`` — AST rules over the package source: no bare/broad
+  excepts, no FLOP-bearing compute outside tracing scopes in
+  models/parallel/ops, no unregistered phase-tag literals.
+
+CLI: ``python -m capital_tpu.lint {program,source}`` (``make lint``), with
+the checked-in ``lint_baseline.jsonl`` suppressing accepted pre-existing
+findings and ``lint:report`` ledger records feeding ``obs lint-report``.
+"""
+
+from capital_tpu.lint.rules import (  # noqa: F401
+    ERROR, INFO, WARN, Finding, Report, gate, sort_findings, summarize,
+)
+from capital_tpu.lint import baseline, program, rules, source  # noqa: F401

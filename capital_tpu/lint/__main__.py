@@ -1,0 +1,150 @@
+"""CLI: ``python -m capital_tpu.lint {program,source} ...``
+
+``program`` builds the flagship targets (cholinv / cacqr / serve buckets),
+runs every sanitizer rule, and gates; ``source`` AST-lints a tree.  Both
+apply the checked-in baseline (``lint_baseline.jsonl``) unless
+``--no-baseline``, can regenerate it with ``--update-baseline``, and append
+ONE schema-tagged ``lint:report`` ledger record with ``--ledger`` — the
+record ``obs lint-report`` reads with serve-report-style exit semantics.
+
+Exit codes: 0 clean (or only findings below --fail-on), 1 gate failure.
+
+Examples::
+
+    python -m capital_tpu.lint source capital_tpu
+    python -m capital_tpu.lint program --platform cpu --ledger lint.jsonl
+    python -m capital_tpu.lint source capital_tpu --no-baseline
+    python -m capital_tpu.lint source capital_tpu --update-baseline
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from capital_tpu.lint import baseline as baseline_mod
+from capital_tpu.lint import rules
+
+
+def _report(pass_name: str, findings, args) -> rules.Report:
+    if args.no_baseline:
+        fresh, suppressed, bl_path = list(findings), [], None
+    else:
+        bl_path = args.baseline
+        fresh, suppressed = baseline_mod.apply(
+            findings, baseline_mod.load(bl_path))
+    return rules.Report(pass_name=pass_name, fresh=fresh,
+                        suppressed=suppressed, baseline_path=bl_path)
+
+
+def _finish(pass_name: str, findings, args) -> int:
+    if args.update_baseline:
+        n = baseline_mod.write(args.baseline, findings)
+        print(f"# wrote {n} baseline record(s) to {args.baseline}")
+        return 0
+    rep = _report(pass_name, findings, args)
+    for f in rules.sort_findings(rep.fresh):
+        print(f.render())
+    counts = rep.counts()
+    ok = rep.ok(args.fail_on)
+    print(
+        f"# lint {pass_name}: {counts['error']} error(s), "
+        f"{counts['warn']} warn(s), {counts['info']} info, "
+        f"{len(rep.suppressed)} baseline-suppressed "
+        f"[fail-on={args.fail_on}] -> {'OK' if ok else 'FAIL'}"
+    )
+    if args.ledger:
+        from capital_tpu.obs import ledger
+
+        ledger.append(args.ledger, ledger.record(
+            "lint:report", ledger.manifest(),
+            lint_report=rep.block(args.fail_on),
+        ))
+    return 0 if ok else 1
+
+
+def _program(args) -> int:
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    from capital_tpu.lint import program, targets
+
+    try:
+        tgts = targets.flagship_targets(args.targets or None)
+    except ValueError as e:
+        raise SystemExit(str(e))
+    findings = []
+    for tgt in tgts:
+        print(f"# sanitizing {tgt.target} "
+              f"(donate={tgt.donate_argnums or '()'})")
+        findings.extend(program.sanitize(
+            tgt, tol_ratio=args.tol_ratio, slack=args.slack,
+            flops_tol_ratio=args.flops_tol,
+            compile_program=not args.no_compile,
+        ))
+    return _finish("program", findings, args)
+
+
+def _source(args) -> int:
+    from capital_tpu.lint import source
+
+    findings = []
+    for path in args.paths or ["capital_tpu"]:
+        findings.extend(source.lint_tree(path))
+    return _finish("source", findings, args)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="capital_tpu.lint")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    def common(sp):
+        sp.add_argument("--fail-on", default="error",
+                        choices=["warn", "error"],
+                        help="lowest severity that fails the gate")
+        sp.add_argument("--baseline", default=baseline_mod.DEFAULT_PATH,
+                        help="suppression file (JSONL of fingerprints)")
+        sp.add_argument("--no-baseline", action="store_true",
+                        help="ignore the baseline: report the full debt")
+        sp.add_argument("--update-baseline", action="store_true",
+                        help="rewrite the baseline from current findings "
+                             "and exit 0")
+        sp.add_argument("--ledger", default=None,
+                        help="append one lint:report record to this JSONL "
+                             "ledger")
+
+    g = sub.add_parser("program",
+                       help="jaxpr/HLO sanitizer over flagship entry points")
+    g.add_argument("targets", nargs="*",
+                   help="target families: cholinv cacqr serve "
+                        "(default: all)")
+    g.add_argument("--platform", default=None,
+                   help="jax platform override (e.g. cpu for the CI gate)")
+    g.add_argument("--tol-ratio", type=float, default=4.0,
+                   help="collective-budget per-phase compiled/model ratio")
+    g.add_argument("--slack", type=int, default=8,
+                   help="collective-budget absolute per-phase allowance")
+    g.add_argument("--flops-tol", type=float, default=2.0,
+                   help="collective-budget whole-program flops ratio")
+    g.add_argument("--no-compile", action="store_true",
+                   help="trace-side rules only (skip donation + "
+                        "collective-budget)")
+    common(g)
+    g.set_defaults(fn=_program)
+
+    s = sub.add_parser("source", help="AST lint over source trees")
+    s.add_argument("paths", nargs="*",
+                   help="files or directories (default: capital_tpu)")
+    common(s)
+    s.set_defaults(fn=_source)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,87 @@
+"""The checked-in baseline/suppression file (``lint_baseline.jsonl``).
+
+A lint gate that blocks on day-one findings never gets adopted; a gate that
+silently grandfathers them never gets fixed.  The baseline is the middle
+path: one JSONL record per *accepted* pre-existing finding (fingerprint +
+enough human-readable context to review it in a diff), checked into the
+repo.  Findings whose fingerprint appears in the baseline are reported as
+``suppressed`` and don't gate; every fresh finding gates immediately.
+
+Workflow (docs/STATIC_ANALYSIS.md):
+
+* ``python -m capital_tpu.lint source --update-baseline`` rewrites the file
+  from the current findings — run it when accepting a debt item, and review
+  the diff like code (each line names the rule and message).
+* ``--no-baseline`` ignores the file: the full-debt view, used by the tests
+  to prove a suppressed finding still *exists* (baseline round-trip).
+* Fixing a finding makes its baseline line dead weight; ``--update-baseline``
+  garbage-collects it.
+
+Fingerprints exclude line numbers on purpose (see rules.Finding.fingerprint)
+so the baseline survives unrelated edits.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Iterable
+
+from capital_tpu.lint import rules
+
+#: Default baseline location, relative to the repo root / CWD.
+DEFAULT_PATH = "lint_baseline.jsonl"
+
+
+def load(path: str) -> set[str]:
+    """Fingerprint set of the baseline at `path`; empty when the file does
+    not exist (a missing baseline means no accepted debt, not an error)."""
+    if not os.path.exists(path):
+        return set()
+    fps: set[str] = set()
+    with open(path) as f:
+        for i, line in enumerate(f):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+                fps.add(str(rec["fingerprint"]))
+            except (json.JSONDecodeError, KeyError, TypeError) as e:
+                raise ValueError(
+                    f"{path}:{i + 1}: malformed baseline line ({e}); fix or "
+                    "regenerate with --update-baseline"
+                ) from e
+    return fps
+
+
+def write(path: str, findings: Iterable[rules.Finding]) -> int:
+    """Rewrite the baseline from `findings` (sorted, one JSON line each,
+    deduplicated by fingerprint).  Returns the number of lines written."""
+    seen: dict[str, rules.Finding] = {}
+    for f in rules.sort_findings(findings):
+        seen.setdefault(f.fingerprint, f)
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as fh:
+        for fp, f in sorted(seen.items(), key=lambda kv: (
+                kv[1].rule, kv[1].target, kv[1].message)):
+            fh.write(json.dumps({
+                "fingerprint": fp,
+                "rule": f.rule,
+                "severity": f.severity,
+                "target": f.target,
+                "message": f.message,
+            }) + "\n")
+    return len(seen)
+
+
+def apply(
+    findings: Iterable[rules.Finding], fingerprints: set[str]
+) -> tuple[list[rules.Finding], list[rules.Finding]]:
+    """Split findings into (fresh, suppressed) against a fingerprint set."""
+    fresh, suppressed = [], []
+    for f in findings:
+        (suppressed if f.fingerprint in fingerprints else fresh).append(f)
+    return fresh, suppressed

@@ -1,0 +1,384 @@
+"""The program sanitizer: invariant rules over jaxpr + compiled HLO.
+
+Each rule verifies one of the invariants PRs 1-4 established, on *any*
+traced program — not just the examples the tests pin:
+
+* ``phase-coverage`` — every FLOP-bearing equation is attributable to a
+  registered `tracing.PHASE_REGISTRY` scope.  An untagged matmul lands in
+  the 'other' bucket of every downstream view (trace tool, drift
+  classifier, autotune tables): cost silently exempt from the
+  communication-avoidance accounting.
+* ``donation-honored`` — declared ``donate_argnums`` actually appear in the
+  executable's ``input_output_alias``.  XLA drops unusable donations with
+  only a Python warning; the serve engine's TPU auto-donation would turn
+  into a silent peak-HBM regression.
+* ``cache-key-hygiene`` — programs destined for an AOT cache (the
+  SolveEngine) must not bake large constants (a captured operand becomes
+  part of every cached executable — the exact hazard the serve engine's
+  host-side fault tap exists to avoid) and should not carry weak-typed
+  avals (weak/strong pairs of the same dtype compile twice and miss the
+  cache).
+* ``no-host-sync`` — no callbacks/infeed/outfeed inside hot-path programs:
+  a host round-trip inside a serve executable stalls the device per batch.
+* ``dtype-drift`` — no f32→f64 promotion leaks under the x64 rig (an
+  accidental Python-float/np.float64 operand doubles every byte the
+  schedule moves).
+* ``collective-budget`` — compiled collective counts stay within the
+  model's drift envelope, reusing `obs/xla_audit.drift` (same tolerance
+  policy, same classifier) instead of duplicating HLO parsing.
+
+All HLO logic is text-based and unit-testable without a device; the jaxpr
+walk threads the enclosing equation's phase into sub-jaxprs (scan/cond
+bodies lose their own name stacks, but the control-flow op itself carries
+the scope it was traced under).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Callable, Optional, Sequence
+
+import jax
+import numpy as np
+
+from capital_tpu.lint import rules
+from capital_tpu.obs import xla_audit
+from capital_tpu.utils import tracing
+
+# -- rule names (the catalog docs/STATIC_ANALYSIS.md documents) -------------
+
+PHASE_COVERAGE = "phase-coverage"
+DONATION_HONORED = "donation-honored"
+CACHE_KEY_HYGIENE = "cache-key-hygiene"
+NO_HOST_SYNC = "no-host-sync"
+DTYPE_DRIFT = "dtype-drift"
+COLLECTIVE_BUDGET = "collective-budget"
+
+PROGRAM_RULES = (
+    PHASE_COVERAGE, DONATION_HONORED, CACHE_KEY_HYGIENE, NO_HOST_SYNC,
+    DTYPE_DRIFT, COLLECTIVE_BUDGET,
+)
+
+#: Primitives whose cost the alpha-beta model prices — the ops that MUST sit
+#: under a registered phase scope.  Elementwise/data-movement primitives are
+#: deliberately absent: padding, masking, and glue legitimately happen
+#: between scopes and carry no modeled flops.
+FLOP_PRIMITIVES = frozenset({
+    "dot_general", "conv_general_dilated", "cholesky", "triangular_solve",
+    "lu", "qr", "householder_product", "svd", "eigh", "schur",
+    "pallas_call",
+})
+
+#: Primitives that synchronize with the host mid-program.  Any of these in a
+#: hot-path program stalls the device once per dispatch.
+HOST_SYNC_PRIMITIVES = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "callback",
+    "infeed", "outfeed",
+})
+
+#: Baked-constant threshold: anything a human would type inline (eye masks,
+#: small index tables) passes; an operand-sized array does not.
+BAKED_CONST_BYTES = 1024
+
+
+@dataclasses.dataclass
+class ProgramTarget:
+    """One entry point under analysis.
+
+    ``fn(*args)`` must be jit-traceable; ``args`` are concrete arrays or
+    ShapeDtypeStructs.  ``donate_argnums`` is what the caller *declares* to
+    jit — the donation rule checks the executable honors it.  ``cacheable``
+    marks programs destined for an AOT executable cache (enables
+    cache-key-hygiene); ``hot_path`` marks per-request/steady-state
+    programs (enables no-host-sync)."""
+
+    name: str
+    fn: Callable
+    args: tuple
+    donate_argnums: tuple[int, ...] = ()
+    cacheable: bool = True
+    hot_path: bool = True
+
+    @property
+    def target(self) -> str:
+        return f"program:{self.name}"
+
+
+# --------------------------------------------------------------------------
+# jaxpr walking
+# --------------------------------------------------------------------------
+
+
+def _phase_of_stack(stack_str: str) -> Optional[str]:
+    """Longest registered phase tag whose dotted form appears in a
+    name-stack string — the same longest-first attribution
+    obs/xla_audit._phase_of applies to HLO lines."""
+    best = None
+    for tag in tracing.PHASE_REGISTRY:
+        dot = tag.replace("::", ".")
+        if dot in stack_str and (best is None or len(dot) > len(
+                best.replace("::", "."))):
+            best = tag
+    return best
+
+
+def _sub_jaxprs(eqn):
+    """Every sub-jaxpr reachable from one equation's params (scan/while
+    bodies, cond branches, pjit/custom_* call jaxprs)."""
+    for v in eqn.params.values():
+        items = v if isinstance(v, (list, tuple)) else (v,)
+        for item in items:
+            inner = getattr(item, "jaxpr", None)
+            if inner is not None and hasattr(inner, "eqns"):
+                yield inner  # ClosedJaxpr -> Jaxpr
+            elif hasattr(item, "eqns"):
+                yield item  # bare Jaxpr
+
+
+def iter_eqns(jaxpr, inherited: Optional[str] = None):
+    """Yield ``(eqn, phase)`` over a jaxpr and all sub-jaxprs.  ``phase`` is
+    the innermost registered tag from the equation's own name stack, else
+    the phase inherited from the enclosing control-flow equation (inner
+    jaxprs are traced with a fresh name stack, but the scan/cond op itself
+    remembers the scope)."""
+    for eqn in jaxpr.eqns:
+        phase = _phase_of_stack(str(eqn.source_info.name_stack)) or inherited
+        yield eqn, phase
+        for sub in _sub_jaxprs(eqn):
+            yield from iter_eqns(sub, phase)
+
+
+def _jaxpr(tgt: ProgramTarget):
+    return jax.make_jaxpr(lambda *a: tgt.fn(*a))(*tgt.args)
+
+
+# --------------------------------------------------------------------------
+# jaxpr rules
+# --------------------------------------------------------------------------
+
+
+def rule_phase_coverage(tgt: ProgramTarget, closed) -> list[rules.Finding]:
+    counts: dict[str, int] = {}
+    for eqn, phase in iter_eqns(closed.jaxpr):
+        if eqn.primitive.name in FLOP_PRIMITIVES and phase is None:
+            counts[eqn.primitive.name] = counts.get(eqn.primitive.name, 0) + 1
+    return [
+        rules.make(
+            PHASE_COVERAGE, rules.ERROR, tgt.target,
+            f"{n} {prim} equation(s) outside every registered tracing.scope "
+            "— their cost lands in the 'other' bucket of every downstream "
+            "view (trace tool, drift classifier, autotune tables)",
+        )
+        for prim, n in sorted(counts.items())
+    ]
+
+
+def rule_no_host_sync(tgt: ProgramTarget, closed) -> list[rules.Finding]:
+    counts: dict[str, int] = {}
+    for eqn, _ in iter_eqns(closed.jaxpr):
+        if eqn.primitive.name in HOST_SYNC_PRIMITIVES:
+            counts[eqn.primitive.name] = counts.get(eqn.primitive.name, 0) + 1
+    return [
+        rules.make(
+            NO_HOST_SYNC, rules.ERROR, tgt.target,
+            f"{n} {prim} op(s) in a hot-path program — each dispatch "
+            "synchronizes with the host (robust/faultinject taps fire "
+            "host-side at serve::ingest for exactly this reason)",
+        )
+        for prim, n in sorted(counts.items())
+    ]
+
+
+def _nbytes(const) -> int:
+    nb = getattr(const, "nbytes", None)
+    if nb is not None:
+        return int(nb)
+    return int(np.asarray(const).nbytes)
+
+
+def rule_cache_key_hygiene(tgt: ProgramTarget, closed) -> list[rules.Finding]:
+    out: list[rules.Finding] = []
+    for const in closed.consts:
+        nb = _nbytes(const)
+        if nb > BAKED_CONST_BYTES:
+            arr = np.asarray(const)
+            out.append(rules.make(
+                CACHE_KEY_HYGIENE, rules.ERROR, tgt.target,
+                f"baked-in constant {arr.dtype}[{','.join(map(str, arr.shape))}] "
+                f"({nb} bytes) captured by closure — it becomes part of "
+                "every AOT cache entry compiled from this program; pass it "
+                "as an argument instead",
+            ))
+    for i, aval in enumerate(closed.in_avals):
+        if getattr(aval, "weak_type", False):
+            out.append(rules.make(
+                CACHE_KEY_HYGIENE, rules.WARN, tgt.target,
+                f"weak-typed input aval #{i} ({aval.dtype}) — weak/strong "
+                "operands of the same dtype trace to different cache keys "
+                "and double-compile; normalize with jnp.asarray(x, dtype)",
+            ))
+    return out
+
+
+def rule_dtype_drift(tgt: ProgramTarget, closed) -> list[rules.Finding]:
+    wide = {np.dtype(np.float64), np.dtype(np.complex128)}
+    in_wide = any(
+        np.dtype(a.dtype) in wide
+        for a in closed.in_avals if hasattr(a, "dtype")
+    ) or any(np.asarray(c).dtype in wide for c in closed.consts)
+    if in_wide:
+        return []  # a genuinely f64 program is allowed to be f64 throughout
+    counts: dict[str, int] = {}
+    for eqn, _ in iter_eqns(closed.jaxpr):
+        for var in eqn.outvars:
+            aval = getattr(var, "aval", None)
+            if aval is not None and hasattr(aval, "dtype") and \
+                    np.dtype(aval.dtype) in wide:
+                counts[eqn.primitive.name] = counts.get(
+                    eqn.primitive.name, 0) + 1
+    return [
+        rules.make(
+            DTYPE_DRIFT, rules.ERROR, tgt.target,
+            f"{n} {prim} equation(s) produce float64/complex128 from a "
+            "narrower-typed program — an x64-rig promotion leak doubles "
+            "every byte the schedule moves (check Python-float / "
+            "np.float64 operands)",
+        )
+        for prim, n in sorted(counts.items())
+    ]
+
+
+# --------------------------------------------------------------------------
+# HLO rules (pure text; unit-testable without a device)
+# --------------------------------------------------------------------------
+
+_ALIAS_ATTR = "input_output_alias={"
+_ALIAS_ENTRY_RE = re.compile(r"\(\s*(\d+)\s*,")
+
+
+def aliased_params(hlo_text: str) -> set[int]:
+    """Parameter numbers that appear as alias sources in the module's
+    ``input_output_alias`` attribute (entries are ``{out_idx}: (param,
+    {param_idx}, kind)``).  Empty when the attribute is absent — XLA
+    dropped every donation.  Brace-matched, not regexed: the attribute
+    nests ``{}`` index tuples."""
+    start = hlo_text.find(_ALIAS_ATTR)
+    if start < 0:
+        return set()
+    i = start + len(_ALIAS_ATTR)
+    depth = 1
+    while i < len(hlo_text) and depth:
+        if hlo_text[i] == "{":
+            depth += 1
+        elif hlo_text[i] == "}":
+            depth -= 1
+        i += 1
+    body = hlo_text[start + len(_ALIAS_ATTR):i - 1]
+    return {int(p) for p in _ALIAS_ENTRY_RE.findall(body)}
+
+
+def check_donation_text(
+    hlo_text: str, donate_argnums: Sequence[int], target: str,
+) -> list[rules.Finding]:
+    """Declared-vs-honored donation on compiled HLO text."""
+    honored = aliased_params(hlo_text)
+    return [
+        rules.make(
+            DONATION_HONORED, rules.ERROR, target,
+            f"donated argument #{i} has no input_output_alias entry in the "
+            "compiled executable — XLA dropped the donation (shape/layout "
+            "mismatch with every output), so the buffer is double-resident "
+            "in HBM for the program's lifetime",
+        )
+        for i in sorted(set(int(i) for i in donate_argnums))
+        if i not in honored
+    ]
+
+
+def check_donation(compiled, donate_argnums: Sequence[int],
+                   target: str = "program:<compiled>") -> list[rules.Finding]:
+    """Donation rule on a compiled executable (jit().lower().compile()
+    product) — also the `SolveEngine(validate=True)` cache-insert assert."""
+    if not donate_argnums:
+        return []
+    return check_donation_text(compiled.as_text(), donate_argnums, target)
+
+
+def rule_collective_budget(
+    tgt: ProgramTarget,
+    audit: xla_audit.ProgramAudit,
+    recorder: tracing.Recorder,
+    tol_ratio: float = 4.0,
+    slack: int = 8,
+    flops_tol_ratio: float = 2.0,
+) -> list[rules.Finding]:
+    """Compiled collectives within the xla_audit drift envelope: the same
+    classifier `make audit` gates on, surfaced as lint findings so one
+    report carries every invariant."""
+    rep = xla_audit.drift(
+        audit, recorder, tol_ratio=tol_ratio, slack=slack,
+        flops_tol_ratio=flops_tol_ratio,
+    )
+    out = [
+        rules.make(
+            COLLECTIVE_BUDGET, rules.ERROR, tgt.target,
+            f"phase {p.phase}: compiled {p.compiled_collectives} collectives "
+            f"vs model {p.model_collectives} — beyond the drift envelope "
+            f"(tol_ratio={tol_ratio}, slack={slack}); the schedule gained "
+            "communication the model does not price",
+        )
+        for p in rep.phases if p.classification == xla_audit.UNDERCOUNT
+    ]
+    if not rep.flops_within:
+        out.append(rules.make(
+            COLLECTIVE_BUDGET, rules.WARN, tgt.target,
+            f"whole-program flops drift: model {rep.model_flops:.3e} vs "
+            f"compiled {rep.compiled_flops:.3e} (allowance "
+            f"{flops_tol_ratio}x)",
+        ))
+    return out
+
+
+# --------------------------------------------------------------------------
+# orchestration
+# --------------------------------------------------------------------------
+
+
+def sanitize(
+    tgt: ProgramTarget,
+    *,
+    tol_ratio: float = 4.0,
+    slack: int = 8,
+    flops_tol_ratio: float = 2.0,
+    compile_program: bool = True,
+) -> list[rules.Finding]:
+    """Run every applicable program rule over one target.
+
+    The jaxpr rules trace abstractly (`jax.make_jaxpr`); the HLO rules
+    compile via a fresh jit wrapper (never the caller's cache entry — the
+    same discipline as obs/xla_audit.audit).  ``compile_program=False``
+    skips the compile-side rules (donation, collective-budget) for callers
+    that only want the trace-side invariants."""
+    closed = _jaxpr(tgt)
+    findings: list[rules.Finding] = []
+    findings += rule_phase_coverage(tgt, closed)
+    if tgt.hot_path:
+        findings += rule_no_host_sync(tgt, closed)
+    if tgt.cacheable:
+        findings += rule_cache_key_hygiene(tgt, closed)
+    findings += rule_dtype_drift(tgt, closed)
+    if compile_program:
+        compiled = jax.jit(
+            lambda *a: tgt.fn(*a), donate_argnums=tgt.donate_argnums,
+        ).lower(*tgt.args).compile()
+        if tgt.donate_argnums:
+            findings += check_donation(compiled, tgt.donate_argnums,
+                                       tgt.target)
+        recorder = xla_audit.trace_model(tgt.fn, *tgt.args)
+        audit = xla_audit.audit_compiled(compiled)
+        findings += rule_collective_budget(
+            tgt, audit, recorder, tol_ratio=tol_ratio, slack=slack,
+            flops_tol_ratio=flops_tol_ratio,
+        )
+    return rules.sort_findings(findings)

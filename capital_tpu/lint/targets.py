@@ -1,0 +1,114 @@
+"""Flagship program targets for the lint gate (`make lint`).
+
+The sanitizer is only as good as the programs it runs over; these builders
+construct the repo's flagship entry points the same way the bench drivers
+and the serve engine do — cholinv, cacqr, and one serve bucket ladder per
+op — sized for a compile-only CPU CI pass (the invariants are properties of
+the *program*, not of the wall clock; `make audit` already owns the big-N
+drift runs).
+
+Serve-bucket targets declare the same donation the engine would
+(ServeConfig.donate semantics): the RHS batch for posv, the operand batch
+for inv — and nothing for lstsq, whose (m, nrhs) RHS can never alias its
+(n, nrhs) solution, which is exactly the donation-honored rule's point.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from capital_tpu.lint.program import ProgramTarget
+
+TARGET_NAMES = ("cholinv", "cacqr", "serve")
+
+
+def _grid():
+    from capital_tpu.parallel.topology import Grid
+
+    return Grid.square(c=1, devices=jax.devices()[:1])
+
+
+def cholinv_target(n: int = 512, dtype=jnp.float32) -> ProgramTarget:
+    from capital_tpu.bench import drivers
+    from capital_tpu.models import cholesky
+
+    grid = _grid()
+    cfg = cholesky.CholinvConfig(base_case_dim=drivers.pick_bc(n, 0))
+    A = drivers._spd(n, dtype)
+
+    def step(a):
+        R, Rinv = cholesky.factor(grid, a, cfg)
+        return R + Rinv
+
+    return ProgramTarget(name=f"cholinv-n{n}", fn=step, args=(A,))
+
+
+def cacqr_target(m: int = 4096, n: int = 256,
+                 dtype=jnp.float32) -> ProgramTarget:
+    from capital_tpu.bench import drivers
+    from capital_tpu.models import cholesky, qr
+
+    grid = _grid()
+    bc = drivers.pick_bc(n, 0)
+    cfg = qr.CacqrConfig(
+        cholinv=cholesky.CholinvConfig(base_case_dim=bc),
+    )
+    A = jax.block_until_ready(
+        jax.random.normal(jax.random.key(0), (m, n), dtype=dtype)
+    )
+
+    def step(a):
+        Q, R = qr.factor(grid, a, cfg)
+        return Q.at[: R.shape[0], : R.shape[1]].add(R.astype(Q.dtype))
+
+    return ProgramTarget(name=f"cacqr-m{m}-n{n}", fn=step, args=(A,))
+
+
+def serve_bucket_targets(
+    n: int = 256, rows: int = 1024, nrhs: int = 8, capacity: int = 4,
+    dtype=jnp.float32,
+) -> list[ProgramTarget]:
+    """One target per served op at one bucket shape, mirroring
+    serve/engine._get_batched's executables and donation declarations."""
+    from capital_tpu.serve import api
+
+    dt = jnp.dtype(dtype)
+    a_sq = jax.ShapeDtypeStruct((capacity, n, n), dt)
+    b_sq = jax.ShapeDtypeStruct((capacity, n, nrhs), dt)
+    a_tall = jax.ShapeDtypeStruct((capacity, rows, n), dt)
+    b_tall = jax.ShapeDtypeStruct((capacity, rows, nrhs), dt)
+    mk = f"b{capacity}-n{n}"
+    return [
+        ProgramTarget(
+            name=f"serve-posv-{mk}", fn=api.batched("posv"),
+            args=(a_sq, b_sq), donate_argnums=(1,),
+        ),
+        ProgramTarget(
+            name=f"serve-lstsq-{mk}-m{rows}", fn=api.batched("lstsq"),
+            args=(a_tall, b_tall),  # no donation: (m,nrhs) RHS can't alias
+        ),
+        ProgramTarget(
+            name=f"serve-inv-{mk}", fn=api.batched("inv"),
+            args=(a_sq,), donate_argnums=(0,),
+        ),
+    ]
+
+
+def flagship_targets(names=None) -> list[ProgramTarget]:
+    """The `make lint` program-pass set.  `names` filters to a subset of
+    TARGET_NAMES (all three families by default)."""
+    names = tuple(names) if names else TARGET_NAMES
+    out: list[ProgramTarget] = []
+    for name in names:
+        if name == "cholinv":
+            out.append(cholinv_target())
+        elif name == "cacqr":
+            out.append(cacqr_target())
+        elif name == "serve":
+            out.extend(serve_bucket_targets())
+        else:
+            raise ValueError(
+                f"unknown lint target {name!r}; expected one of {TARGET_NAMES}"
+            )
+    return out

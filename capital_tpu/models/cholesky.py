@@ -659,13 +659,14 @@ def factor(
         # outside every leaf window, and skipping jnp.zeros would return
         # hardware garbage there (invisible on CPU interpret, which
         # zero-fills unvisited blocks).
-        Rp = pallas_tpu.zeros_dead_lower(p, A.dtype, tile)
-        extra = (
-            ()
-            if cfg.complete_inv or node.is_base
-            else ((0, node.top[0].n, node.top[0].n, p - node.top[0].n),)
-        )
-        RIp = pallas_tpu.zeros_dead_lower(p, A.dtype, tile, extra=extra)
+        with tracing.scope("CI::buffers"):
+            Rp = pallas_tpu.zeros_dead_lower(p, A.dtype, tile)
+            extra = (
+                ()
+                if cfg.complete_inv or node.is_base
+                else ((0, node.top[0].n, node.top[0].n, p - node.top[0].n),)
+            )
+            RIp = pallas_tpu.zeros_dead_lower(p, A.dtype, tile, extra=extra)
     else:
         Rp = grid.pin(jnp.zeros((p, p), dtype=A.dtype))
         RIp = grid.pin(jnp.zeros((p, p), dtype=A.dtype))
@@ -692,10 +693,11 @@ def factor_buffers(
     tile = _zeros_plan(grid, node, cfg)
     with pallas_tpu.platform_scope(grid.platform):
         if tile:
-            return (
-                pallas_tpu.zeros_dead_lower(p, dtype, tile),
-                pallas_tpu.zeros_dead_lower(p, dtype, tile),
-            )
+            with tracing.scope("CI::buffers"):
+                return (
+                    pallas_tpu.zeros_dead_lower(p, dtype, tile),
+                    pallas_tpu.zeros_dead_lower(p, dtype, tile),
+                )
     # two DISTINCT buffers: sharing one value between two aliased consumer
     # chains would be the multi-use copy hazard this API exists to avoid
     return (

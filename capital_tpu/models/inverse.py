@@ -260,6 +260,7 @@ def rectri(
         return summa.transpose(grid, rectri(grid, summa.transpose(grid, T), "L", cfg))
 
     from capital_tpu.models.cholesky import pad_embed_identity, padded_dim
+    from capital_tpu.utils import tracing
 
     # Single device: pad to the SMALLER of the bc-chain size (perfectly
     # aligned windows) and plain 256-lane alignment: the recursion handles
@@ -282,9 +283,10 @@ def rectri(
         # and the merge panels cover the whole strict-lower triangle, so
         # only the strict-UPPER tiles need the zero fill (~half the init
         # HBM traffic of a dense jnp.zeros; ~3 ms at the 49152 bench row)
-        out = grid.pin(
-            pallas_tpu.zeros_dead_lower(p, T.dtype, t, dead="upper")
-        )
+        with tracing.scope("RT::buffers"):
+            out = grid.pin(
+                pallas_tpu.zeros_dead_lower(p, T.dtype, t, dead="upper")
+            )
         out = _rectri_batched_prefix(grid, Tp, out, p, t, cfg)
     else:
         out = grid.pin(jnp.zeros((p, p), dtype=T.dtype))

@@ -18,6 +18,10 @@ value drop WITHOUT the status still flags (docs/ROBUSTNESS.md).
 (serve/stats.py; docs/SERVING.md) and optionally gates on cache hit-rate /
 p99 latency — the second half of ``make serve-smoke``.
 
+``lint-report`` summarizes the lint:report records of a ledger
+(capital_tpu.lint CLI; docs/STATIC_ANALYSIS.md) and gates on each report's
+own pass/fail outcome — the second half of ``make lint``.
+
 Examples::
 
     python -m capital_tpu.obs audit cholinv --n 4096
@@ -240,6 +244,56 @@ def _serve_report(args) -> int:
     return 0
 
 
+def _lint_report(args) -> int:
+    """Summarize the lint:report records of a ledger (the `make lint`
+    second half).  Exit 2 on a malformed record, 1 when any report's gate
+    failed (or --require-pass names a pass with no record)."""
+    from capital_tpu.obs import ledger
+
+    recs = ledger.read(args.ledger)
+    rows = [r for r in recs if r.get("lint_report") is not None]
+    bad = 0
+    for i, r in enumerate(rows):
+        for p in ledger.validate_lint_report(r["lint_report"]):
+            print(f"malformed lint_report record #{i}: {p}", file=sys.stderr)
+            bad += 1
+    if bad:
+        return 2
+    required = set(args.require_pass or [])
+    if not rows:
+        print(f"# no lint_report records in {args.ledger} "
+              f"({len(recs)} records total)")
+        return 1 if required else 0
+    failures = []
+    seen = set()
+    for i, r in enumerate(rows):
+        lr = r["lint_report"]
+        seen.add(lr["pass"])
+        counts = lr["counts"]
+        print(
+            f"# [{i}] pass={lr['pass']} fail_on={lr['fail_on']} "
+            f"ok={lr['ok']} errors={counts['error']} warns={counts['warn']} "
+            f"info={counts['info']} suppressed={lr['suppressed']}"
+        )
+        for f in lr["findings"]:
+            print(f"#     {f['severity']} {f['rule']} {f['target']}: "
+                  f"{f['message']}")
+        if not lr["ok"]:
+            failures.append(
+                f"record #{i}: {lr['pass']} pass failed its "
+                f"fail_on={lr['fail_on']} gate "
+                f"({counts['error']} error(s), {counts['warn']} warn(s))"
+            )
+    for name in sorted(required - seen):
+        failures.append(f"required pass {name!r} has no lint_report record")
+    for f in failures:
+        print(f"lint-report gate FAIL: {f}", file=sys.stderr)
+    if failures:
+        return 1
+    print(f"# lint-report OK ({len(rows)} lint_report record(s))")
+    return 0
+
+
 def _diff(args) -> int:
     from capital_tpu.obs import ledger
 
@@ -317,6 +371,17 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--max-p99-ms", type=float, default=None,
                    help="fail when any record's p99 latency exceeds this")
     s.set_defaults(fn=_serve_report)
+
+    lr = sub.add_parser(
+        "lint-report",
+        help="summarize lint:report records (gate on per-pass outcomes)",
+    )
+    lr.add_argument("ledger")
+    lr.add_argument("--require-pass", action="append", default=None,
+                    metavar="PASS",
+                    help="fail unless a record for this pass exists "
+                         "(repeatable: program, source)")
+    lr.set_defaults(fn=_lint_report)
 
     g = sub.add_parser(
         "robust-gate",

@@ -277,6 +277,68 @@ def validate_request_stats(block) -> list[str]:
     return probs
 
 
+#: lint_report blocks (capital_tpu.lint rules.Report.block) get the same
+#: treatment as request_stats: structurally validated on every diff, never
+#: metric-compared — a lint outcome is a property of the *source tree*, not
+#: of a kernel's speed, and its gate lives in ``obs lint-report``.
+_LINT_PASSES = ("program", "source")
+_LINT_FAIL_ON = ("warn", "error")
+_LINT_COUNT_KEYS = ("error", "warn", "info")
+_LINT_FINDING_KEYS = ("rule", "severity", "target", "message", "fingerprint")
+
+
+def validate_lint_report(block) -> list[str]:
+    """Schema problems of one lint_report block ([] = valid).  Checked by
+    diff() on every record carrying the block and by ``obs lint-report``;
+    a problem list (not an exception) so the CLI can print all of them."""
+    if not isinstance(block, dict):
+        return [f"lint_report is {type(block).__name__}, expected object"]
+    probs = []
+    if block.get("schema_version") != SCHEMA_VERSION:
+        probs.append(
+            f"schema_version {block.get('schema_version')!r} != "
+            f"{SCHEMA_VERSION}"
+        )
+    if block.get("pass") not in _LINT_PASSES:
+        probs.append(
+            f"pass must be one of {_LINT_PASSES}, got {block.get('pass')!r}"
+        )
+    if block.get("fail_on") not in _LINT_FAIL_ON:
+        probs.append(
+            f"fail_on must be one of {_LINT_FAIL_ON}, "
+            f"got {block.get('fail_on')!r}"
+        )
+    if not isinstance(block.get("ok"), bool):
+        probs.append(f"ok must be a bool, got {block.get('ok')!r}")
+    counts = block.get("counts")
+    if not isinstance(counts, dict):
+        probs.append(f"counts must be an object, got {counts!r}")
+    else:
+        for key in _LINT_COUNT_KEYS:
+            v = counts.get(key)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                probs.append(
+                    f"counts.{key} must be a non-negative int, got {v!r}"
+                )
+    sup = block.get("suppressed")
+    if not isinstance(sup, int) or isinstance(sup, bool) or sup < 0:
+        probs.append(f"suppressed must be a non-negative int, got {sup!r}")
+    findings = block.get("findings")
+    if not isinstance(findings, list):
+        probs.append(f"findings must be a list, got {findings!r}")
+    else:
+        for i, f in enumerate(findings):
+            if not isinstance(f, dict):
+                probs.append(f"findings[{i}] is not an object")
+                continue
+            for key in _LINT_FINDING_KEYS:
+                if not isinstance(f.get(key), str) or not f.get(key):
+                    probs.append(
+                        f"findings[{i}].{key} missing or not a string"
+                    )
+    return probs
+
+
 def _event_status(rec: dict) -> Optional[str]:
     """The robustness status of a record, when it carries one.
 
@@ -290,9 +352,13 @@ def _event_status(rec: dict) -> Optional[str]:
     the robust path before benchmarking.  'serve' marks request_stats
     records (serve/stats.py): a served workload's latency mix is the
     workload's property, not a kernel's — its regression story is
-    ``obs serve-report`` gates, not the bench metric check."""
+    ``obs serve-report`` gates, not the bench metric check.  'lint' marks
+    lint_report records (capital_tpu.lint CLI) for the same reason — their
+    gate is ``obs lint-report``."""
     if rec.get("request_stats") is not None:
         return "serve"
+    if rec.get("lint_report") is not None:
+        return "lint"
     ev = rec.get("event")
     if isinstance(ev, dict) and ev.get("status"):
         return str(ev["status"])
@@ -335,6 +401,13 @@ def diff(
             if probs:
                 raise LedgerIncompatible(
                     "malformed request_stats record: " + "; ".join(probs)
+                )
+        lr = r.get("lint_report")
+        if lr is not None:
+            probs = validate_lint_report(lr)
+            if probs:
+                raise LedgerIncompatible(
+                    "malformed lint_report record: " + "; ".join(probs)
                 )
     a_by = {_key(r): r for r in a_recs}
     b_by = {_key(r): r for r in b_recs}

@@ -28,12 +28,15 @@ GSPMD window materializations").  This module makes that audit a library:
 from __future__ import annotations
 
 import dataclasses
+import logging
 import re
 from typing import Callable, Iterable, Optional
 
 import jax
 
 from capital_tpu.utils import tracing
+
+_log = logging.getLogger(__name__)
 
 #: Collective kinds inventoried, matching the pinned audit tests.  The scan
 #: counts both the sync form (``all-gather(``) and the async pair's start op
@@ -172,7 +175,10 @@ def audit_text(hlo_text: str) -> ProgramAudit:
 def _cost_analysis(compiled) -> dict:
     try:
         ca = compiled.cost_analysis()
-    except Exception:
+    except Exception as e:
+        # some backends/jax versions simply don't implement it; the audit
+        # degrades to zero flops facts, but the swallow must stay visible
+        _log.debug("cost_analysis unavailable: %s: %s", type(e).__name__, e)
         return {}
     if isinstance(ca, (list, tuple)):  # older jax returns one dict per device
         ca = ca[0] if ca else {}
@@ -194,8 +200,10 @@ def audit_compiled(compiled) -> ProgramAudit:
         audit.peak_hbm_bytes = (
             audit.argument_bytes + audit.output_bytes + audit.temp_bytes
         )
-    except Exception:
-        pass  # backends without memory_analysis keep the zero defaults
+    except Exception as e:
+        # backends without memory_analysis keep the zero defaults
+        _log.debug("memory_analysis unavailable: %s: %s",
+                   type(e).__name__, e)
     return audit
 
 

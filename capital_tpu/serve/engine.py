@@ -28,9 +28,15 @@ what PRs 1-3 already provide (docs/SERVING.md has the full lifecycle):
 * **Donation** — batched RHS / operand buffers are donated on TPU only
   (ServeConfig.donate=None auto): CPU's runtime ignores donation with a
   warning per executable, and the engine builds those batch arrays itself
-  so donating them is always safe.  The single-problem models route never
-  donates: schedules like cholinv's schur_in_place carry their own aliasing
-  contracts on caller buffers.
+  so donating them is always safe.  Only aliasable buffers are declared:
+  posv donates its RHS batch (solution is shape-for-shape), inv its operand
+  batch; lstsq donates nothing — its (m, nrhs) RHS cannot alias the
+  (n, nrhs) solution, and XLA would silently drop the declaration.
+  ``SolveEngine(validate=True)`` asserts the compiled input_output_alias
+  honors every declared donation at cache-insert time (the lint
+  donation-honored rule; docs/STATIC_ANALYSIS.md).  The single-problem
+  models route never donates: schedules like cholinv's schur_in_place carry
+  their own aliasing contracts on caller buffers.
 """
 
 from __future__ import annotations
@@ -137,11 +143,17 @@ class SolveEngine:
     thread-safe (a single dispatch loop owns it, like a jax program)."""
 
     def __init__(self, grid: Optional[Grid] = None,
-                 cfg: ServeConfig = ServeConfig()):
+                 cfg: ServeConfig = ServeConfig(), *,
+                 validate: bool = False):
         if cfg.oversize not in ("models", "reject"):
             raise ValueError(f"unknown oversize policy {cfg.oversize!r}")
         self.grid = grid or Grid.square(c=1, devices=jax.devices()[:1])
         self.cfg = cfg
+        # validate: run the lint donation-honored rule on every executable at
+        # cache-insert time — a declared donate_argnums that XLA silently
+        # drops (shape mismatch with every output) raises instead of leaving
+        # the batch buffer double-resident for the cache entry's lifetime.
+        self.validate = validate
         self.stats = stats.Collector()
         self._exe: dict[tuple, object] = {}
         self._queues: dict[batching.Bucket, list[_Pending]] = {}
@@ -182,12 +194,27 @@ class SolveEngine:
             specs.append(
                 jax.ShapeDtypeStruct((bucket.capacity,) + bucket.b_shape, dt)
             )
-            if self._donate():
-                dn = (1,)  # the RHS batch: posv aliases it shape-for-shape
+            # Only posv's solution aliases its RHS shape-for-shape.  lstsq's
+            # (m, nrhs) RHS can never alias the (n, nrhs) solution, so XLA
+            # would silently drop that donation (lint rule donation-honored)
+            # and the batch would sit double-resident in HBM.
+            if self._donate() and bucket.op == "posv":
+                dn = (1,)
         elif self._donate():
             dn = (0,)  # inv: the operand batch aliases the inverse batch
         fn = api.batched(bucket.op, self.cfg.precision)
         exe = jax.jit(fn, donate_argnums=dn).lower(*specs).compile()
+        if self.validate and dn:
+            from capital_tpu.lint import program as lint_program
+
+            probs = lint_program.check_donation(
+                exe, dn, target=f"serve:{bucket.key}",
+            )
+            if probs:
+                raise AssertionError(
+                    "donation dropped at cache insert: "
+                    + "; ".join(f.message for f in probs)
+                )
         self._exe[key] = exe
         return exe
 

@@ -109,17 +109,21 @@ def device_spec(device: Optional[jax.Device] = None) -> DeviceSpec:
 #: Innermost-first ordering is not required (matching is longest-tag-first
 #: downstream); grouping by algorithm keeps the registry reviewable.
 PHASE_REGISTRY: tuple[str, ...] = (
-    # cholinv (cholesky.py, reference cholinv.hpp:94-136)
-    "CI::factor_diag", "CI::trsm", "CI::tmu", "CI::inv",
+    # cholinv (cholesky.py, reference cholinv.hpp:94-136).  CI::buffers is
+    # the output-buffer zero-init (pallas zeros_dead_lower) at factor
+    # entry — schedule-inserted data movement, tagged so the lint
+    # phase-coverage rule and the trace tool attribute it instead of
+    # bucketing kernel writes under 'other'.
+    "CI::factor_diag", "CI::trsm", "CI::tmu", "CI::inv", "CI::buffers",
     # cacqr (qr.py, reference cacqr.hpp:82-116; CQR::scale is historical —
     # kept so old traces/ledgers still bucket).  CQR::recover is the
     # shifted-CholeskyQR escalation path (robust/recovery.py) — present in
     # the program only under a RobustConfig, executed only on breakdown.
     "CQR::gram", "CQR::chol", "CQR::scale", "CQR::merge", "CQR::fused",
     "CQR::formR", "CQR::recover",
-    # rectri (inverse.py)
+    # rectri (inverse.py).  RT::buffers: see CI::buffers.
     "RT::base", "RT::merge", "RT::batch_base", "RT::batch_merge",
-    "RT::batch_write",
+    "RT::batch_write", "RT::buffers",
     # trsm (trsm.py)
     "TS::dinv", "TS::leaf", "TS::update",
     # serve (serve/, docs/SERVING.md).  serve::ingest is HOST-side — the

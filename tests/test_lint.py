@@ -1,0 +1,551 @@
+"""Static-analysis tests: the lint rules engine, the program sanitizer (one
+failing + one passing program per rule), the source AST lint, the baseline
+round-trip, the lint:report ledger/CLI seam, and the SolveEngine
+validate=True donation assert (docs/STATIC_ANALYSIS.md).
+
+Program-rule tests build tiny synthetic jit programs on the conftest CPU rig
+(8 virtual devices, x64 on) — each compiles in well under a second.  HLO
+donation parsing is additionally covered on handwritten module text, so the
+rule's text contract survives a jax upgrade changing what CPU compiles.
+"""
+
+import json
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from capital_tpu.lint import __main__ as lint_main
+from capital_tpu.lint import baseline, program, rules, source
+from capital_tpu.obs import __main__ as obs_main
+from capital_tpu.obs import ledger, xla_audit
+from capital_tpu.serve import ServeConfig, SolveEngine
+from capital_tpu.serve import api as serve_api
+from capital_tpu.utils import tracing
+
+
+def _target(fn, *args, **kw):
+    return program.ProgramTarget(name="t", fn=fn, args=args, **kw)
+
+
+def _by_rule(findings, rule):
+    return [f for f in findings if f.rule == rule]
+
+
+def _trace_rules(fn, *args, **kw):
+    """Trace-side findings only (no compile): the per-rule tests."""
+    return program.sanitize(_target(fn, *args, **kw), compile_program=False)
+
+
+# ---------------------------------------------------------------------------
+# rules engine
+# ---------------------------------------------------------------------------
+
+
+class TestRulesEngine:
+    def test_unknown_severity_rejected(self):
+        with pytest.raises(ValueError, match="severity"):
+            rules.make("r", "fatal", "t", "m")
+
+    def test_fingerprint_ignores_line_number(self):
+        a = rules.make("r", rules.ERROR, "f.py", "msg", line=10)
+        b = rules.make("r", rules.ERROR, "f.py", "msg", line=99)
+        c = rules.make("r", rules.ERROR, "f.py", "other", line=10)
+        assert a.fingerprint == b.fingerprint
+        assert a.fingerprint != c.fingerprint
+
+    def test_gate_severity_ladder(self):
+        err = [rules.make("r", rules.ERROR, "t", "m")]
+        wrn = [rules.make("r", rules.WARN, "t", "m")]
+        inf = [rules.make("r", rules.INFO, "t", "m")]
+        assert not rules.gate(err, "error")
+        assert rules.gate(wrn, "error")
+        assert not rules.gate(wrn, "warn")
+        assert rules.gate(inf, "warn")
+        with pytest.raises(ValueError, match="fail-on"):
+            rules.gate([], "info")
+
+    def test_sort_errors_first(self):
+        w = rules.make("r", rules.WARN, "a.py", "m", line=1)
+        e = rules.make("r", rules.ERROR, "z.py", "m", line=9)
+        assert rules.sort_findings([w, e]) == [e, w]
+
+
+# ---------------------------------------------------------------------------
+# program sanitizer, one failing + one passing program per rule
+# ---------------------------------------------------------------------------
+
+
+class TestPhaseCoverage:
+    def test_untagged_matmul_fails(self):
+        x = jnp.ones((8, 8), jnp.float64)
+        found = _by_rule(_trace_rules(lambda a: a @ a, x),
+                         program.PHASE_COVERAGE)
+        assert len(found) == 1
+        assert "dot_general" in found[0].message
+        assert found[0].severity == rules.ERROR
+
+    def test_scoped_matmul_passes(self):
+        x = jnp.ones((8, 8), jnp.float64)
+
+        def fn(a):
+            with tracing.scope("CI::tmu"):
+                return a @ a
+
+        assert _by_rule(_trace_rules(fn, x), program.PHASE_COVERAGE) == []
+
+    def test_scan_body_inherits_enclosing_phase(self):
+        # scan bodies trace with a fresh name stack; the walk must carry
+        # the scan equation's own scope into the body's matmul.
+        x = jnp.ones((8, 8), jnp.float64)
+
+        def fn(a):
+            with tracing.scope("CI::tmu"):
+                out, _ = jax.lax.scan(
+                    lambda c, _: (c @ a, None), a, None, length=3)
+            return out
+
+        assert _by_rule(_trace_rules(fn, x), program.PHASE_COVERAGE) == []
+
+
+class TestNoHostSync:
+    @staticmethod
+    def _callback_fn(a):
+        with tracing.scope("CI::tmu"):
+            b = jax.pure_callback(
+                lambda v: np.asarray(v), jax.ShapeDtypeStruct(a.shape, a.dtype), a
+            )
+            return b @ b
+
+    def test_callback_in_hot_path_fails(self):
+        x = jnp.ones((4, 4), jnp.float64)
+        found = _by_rule(_trace_rules(self._callback_fn, x),
+                         program.NO_HOST_SYNC)
+        assert len(found) == 1
+        assert "pure_callback" in found[0].message
+
+    def test_cold_path_exempt(self):
+        x = jnp.ones((4, 4), jnp.float64)
+        found = _by_rule(_trace_rules(self._callback_fn, x, hot_path=False),
+                         program.NO_HOST_SYNC)
+        assert found == []
+
+
+class TestCacheKeyHygiene:
+    def test_baked_operand_sized_constant_fails(self):
+        big = jnp.asarray(np.ones((64, 64)))  # 32 KiB closure capture
+
+        def fn(a):
+            with tracing.scope("CI::tmu"):
+                return a @ big
+
+        found = _by_rule(_trace_rules(fn, jnp.ones((64, 64))),
+                         program.CACHE_KEY_HYGIENE)
+        assert len(found) == 1
+        assert "baked-in constant" in found[0].message
+        assert found[0].severity == rules.ERROR
+
+    def test_small_inline_constant_passes(self):
+        def fn(a):
+            with tracing.scope("CI::tmu"):
+                return a @ a + jnp.eye(8, dtype=a.dtype)[:4, :4].sum()
+
+        found = _by_rule(_trace_rules(fn, jnp.ones((4, 4))),
+                         program.CACHE_KEY_HYGIENE)
+        assert found == []
+
+    def test_weak_typed_input_warns(self):
+        def fn(a, s):
+            with tracing.scope("CI::tmu"):
+                return a @ a * s
+
+        # a bare Python scalar traces to a weak-typed aval — the
+        # double-compile hazard for an AOT cache keyed on avals
+        found = _by_rule(_trace_rules(fn, jnp.ones((4, 4)), 2.0),
+                         program.CACHE_KEY_HYGIENE)
+        assert [f.severity for f in found] == [rules.WARN]
+        assert "weak" in found[0].message
+
+    def test_non_cacheable_target_exempt(self):
+        big = jnp.asarray(np.ones((64, 64)))
+        found = _by_rule(
+            _trace_rules(lambda a: a @ big, jnp.ones((64, 64)),
+                         cacheable=False),
+            program.CACHE_KEY_HYGIENE,
+        )
+        assert found == []
+
+
+class TestDtypeDrift:
+    def test_f64_leak_from_f32_program_fails(self):
+        def fn(a):
+            with tracing.scope("CI::tmu"):
+                w = a.astype(jnp.float64)
+                return w @ w
+
+        found = _by_rule(_trace_rules(fn, jnp.ones((4, 4), jnp.float32)),
+                         program.DTYPE_DRIFT)
+        assert found and all(f.severity == rules.ERROR for f in found)
+
+    def test_pure_f32_program_passes(self):
+        def fn(a):
+            with tracing.scope("CI::tmu"):
+                return a @ a * jnp.float32(2.0)
+
+        assert _by_rule(_trace_rules(fn, jnp.ones((4, 4), jnp.float32)),
+                        program.DTYPE_DRIFT) == []
+
+    def test_genuinely_f64_program_allowed(self):
+        def fn(a):
+            with tracing.scope("CI::tmu"):
+                return a @ a
+
+        assert _by_rule(_trace_rules(fn, jnp.ones((4, 4), jnp.float64)),
+                        program.DTYPE_DRIFT) == []
+
+
+class TestDonationHonored:
+    HONORED = """HloModule m, input_output_alias={ {}: (0, {}, may-alias) }
+ENTRY e { ROOT p = f32[4]{0} parameter(0) }
+"""
+    NESTED = """HloModule m, input_output_alias={ {0}: (0, {}, may-alias), {1}: (2, {}, may-alias) }, entry_computation_layout={(f32[4]{0})->f32[4]{0}}
+ENTRY e { ROOT p = f32[4]{0} parameter(0) }
+"""
+    DROPPED = """HloModule m, entry_computation_layout={(f32[4]{0})->f32[]}
+ENTRY e { ROOT p = f32[4]{0} parameter(0) }
+"""
+
+    def test_aliased_params_parses_nested_braces(self):
+        assert program.aliased_params(self.HONORED) == {0}
+        assert program.aliased_params(self.NESTED) == {0, 2}
+        assert program.aliased_params(self.DROPPED) == set()
+
+    def test_text_check_flags_only_dropped_args(self):
+        found = program.check_donation_text(self.NESTED, (0, 1, 2), "program:t")
+        assert [f.rule for f in found] == [program.DONATION_HONORED]
+        assert "#1" in found[0].message
+
+    def test_compiled_honored_donation_passes(self):
+        exe = jax.jit(lambda x: x + 1.0, donate_argnums=(0,)) \
+            .lower(jax.ShapeDtypeStruct((32,), jnp.float64)).compile()
+        assert program.check_donation(exe, (0,), "program:t") == []
+
+    def test_compiled_dropped_donation_fails(self):
+        # a (32,) input can never alias the scalar output; XLA drops the
+        # donation with only a UserWarning — the rule turns it into an error
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            exe = jax.jit(lambda x: jnp.sum(x), donate_argnums=(0,)) \
+                .lower(jax.ShapeDtypeStruct((32,), jnp.float64)).compile()
+        found = program.check_donation(exe, (0,), "program:t")
+        assert [f.rule for f in found] == [program.DONATION_HONORED]
+
+
+class TestCollectiveBudget:
+    @staticmethod
+    def _audit(phase_collectives, flops=0.0):
+        counts = {"all-reduce": sum(phase_collectives.values())}
+        return xla_audit.ProgramAudit(
+            collective_counts=counts, collective_bytes={},
+            phase_collectives=dict(phase_collectives), phase_comm_bytes={},
+            flops=flops, bytes_accessed=0.0, peak_hbm_bytes=0.0,
+            argument_bytes=0.0, output_bytes=0.0, temp_bytes=0.0,
+        )
+
+    @staticmethod
+    def _recorder(collectives=1, flops=0.0):
+        with tracing.Recorder() as rec:
+            with tracing.scope("CI::tmu"):
+                tracing.emit(flops=flops, collectives=collectives,
+                             comm_bytes=64.0)
+        return rec
+
+    def test_model_undercount_fails(self):
+        tgt = _target(lambda: None)
+        found = program.rule_collective_budget(
+            tgt, self._audit({"CI::tmu": 100}), self._recorder(1),
+            tol_ratio=4.0, slack=8,
+        )
+        assert [f.severity for f in found] == [rules.ERROR]
+        assert "CI::tmu" in found[0].message
+
+    def test_within_envelope_and_gspmd_extra_pass(self):
+        tgt = _target(lambda: None)
+        # 3 <= 1*4+8 within; a phase the model never books is EXTRA (GSPMD
+        # motion), tolerated by the same policy make audit applies
+        found = program.rule_collective_budget(
+            tgt, self._audit({"CI::tmu": 3, "CQR::gram": 5}),
+            self._recorder(1),
+        )
+        assert found == []
+
+    def test_whole_program_flops_drift_warns(self):
+        tgt = _target(lambda: None)
+        found = program.rule_collective_budget(
+            tgt, self._audit({"CI::tmu": 1}, flops=1e12),
+            self._recorder(1, flops=1e9), flops_tol_ratio=2.0,
+        )
+        assert [f.severity for f in found] == [rules.WARN]
+        assert "flops drift" in found[0].message
+
+
+class TestSanitizeEndToEnd:
+    def test_clean_program_is_clean(self):
+        def fn(a, b):
+            with tracing.scope("CI::tmu"):
+                return a @ b
+
+        tgt = _target(fn, jnp.ones((16, 16), jnp.float64),
+                      jnp.ones((16, 16), jnp.float64))
+        assert program.sanitize(tgt) == []
+
+    def test_flagship_serve_targets_are_clean(self):
+        from capital_tpu.lint import targets
+
+        for tgt in targets.serve_bucket_targets(n=16, rows=64, nrhs=2,
+                                                capacity=2):
+            assert program.sanitize(tgt) == [], tgt.name
+
+
+# ---------------------------------------------------------------------------
+# source lint
+# ---------------------------------------------------------------------------
+
+
+def _src(text, path="capital_tpu/models/fake.py"):
+    return source.lint_source(path, text=text)
+
+
+class TestSourceExcepts:
+    def test_bare_except_fails(self):
+        found = _by_rule(_src("try:\n    f()\nexcept:\n    pass\n"),
+                        source.BARE_EXCEPT)
+        assert [f.line for f in found] == [3]
+
+    def test_broad_except_without_exit_fails(self):
+        found = _by_rule(
+            _src("try:\n    f()\nexcept Exception:\n    pass\n"),
+            source.BROAD_EXCEPT)
+        assert len(found) == 1
+
+    @pytest.mark.parametrize("handler", [
+        "except ValueError:\n    pass\n",
+        "except Exception:\n    raise\n",
+        "except Exception as e:\n    log.warning('gone: %s', e)\n",
+        "except Exception:  # lint: allow-broad-except — shutdown path\n"
+        "    pass\n",
+    ])
+    def test_accepted_spellings_pass(self, handler):
+        found = _src("try:\n    f()\n" + handler)
+        assert _by_rule(found, source.BROAD_EXCEPT) == []
+        assert _by_rule(found, source.BARE_EXCEPT) == []
+
+
+class TestSourceComputeScope:
+    def test_unscoped_matmul_in_models_warns(self):
+        found = _by_rule(_src("import jax.numpy as jnp\n"
+                              "def f(a):\n    return jnp.matmul(a, a)\n"),
+                         source.COMPUTE_OUTSIDE_SCOPE)
+        assert [f.severity for f in found] == [rules.WARN]
+
+    def test_matmult_operator_detected(self):
+        found = _by_rule(_src("def f(a):\n    return a @ a\n"),
+                         source.COMPUTE_OUTSIDE_SCOPE)
+        assert len(found) == 1
+
+    def test_scoped_matmul_passes(self):
+        text = ("from capital_tpu.utils import tracing\n"
+                "def f(a):\n"
+                "    with tracing.scope('CI::tmu'):\n"
+                "        return a @ a\n")
+        assert _by_rule(_src(text), source.COMPUTE_OUTSIDE_SCOPE) == []
+
+    def test_rule_limited_to_scoped_dirs(self):
+        text = "def f(a):\n    return a @ a\n"
+        found = _src(text, path="capital_tpu/bench/fake.py")
+        assert _by_rule(found, source.COMPUTE_OUTSIDE_SCOPE) == []
+
+
+class TestSourcePhaseTags:
+    def test_unregistered_scope_tag_fails(self):
+        found = _by_rule(_src("with tracing.scope('CI::nope'):\n    pass\n"),
+                         source.UNREGISTERED_PHASE_TAG)
+        assert len(found) == 1 and "CI::nope" in found[0].message
+
+    def test_registered_scope_and_tap_pass(self):
+        text = ("with tracing.scope('CI::tmu'):\n"
+                "    x = faultinject.tap(x, point='serve::ingest')\n")
+        assert _by_rule(_src(text), source.UNREGISTERED_PHASE_TAG) == []
+
+    def test_unregistered_tap_point_fails(self):
+        found = _by_rule(_src("x = faultinject.tap(x, point='bad::tag')\n"),
+                         source.UNREGISTERED_PHASE_TAG)
+        assert len(found) == 1
+
+    def test_syntax_error_is_a_finding_not_a_crash(self):
+        found = _src("def f(:\n")
+        assert [f.rule for f in found] == ["syntax"]
+
+    def test_seed_tree_has_no_source_errors(self):
+        # the satellite contract: every error-severity violation the lint
+        # found at seed was FIXED, not baselined (warns are the baseline)
+        errors = [f for f in source.lint_tree("capital_tpu")
+                  if f.severity == rules.ERROR]
+        assert errors == [], [f.render() for f in errors]
+
+
+# ---------------------------------------------------------------------------
+# baseline round-trip + CLI gate
+# ---------------------------------------------------------------------------
+
+BAD_SOURCE = "try:\n    f()\nexcept:\n    pass\n"
+
+
+class TestBaselineRoundTrip:
+    def test_finding_to_baseline_to_suppressed_to_refail(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(BAD_SOURCE)
+        bl = str(tmp_path / "bl.jsonl")
+        args = ["source", str(bad), "--baseline", bl]
+
+        # 1. fresh violation fails the gate
+        assert lint_main.main(args) == 1
+        # 2. baseline it
+        assert lint_main.main(args + ["--update-baseline"]) == 0
+        recs = [json.loads(ln) for ln in
+                open(bl).read().splitlines()]
+        assert [r["rule"] for r in recs] == [source.BARE_EXCEPT]
+        # 3. suppressed now
+        assert lint_main.main(args) == 0
+        # 4. --no-baseline surfaces the full debt again
+        assert lint_main.main(args + ["--no-baseline"]) == 1
+
+    def test_baseline_survives_line_churn(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(BAD_SOURCE)
+        fps = {f.fingerprint for f in source.lint_source(str(bad))}
+        bad.write_text("# comment\n# comment\n" + BAD_SOURCE)
+        moved = source.lint_source(str(bad))
+        fresh, suppressed = baseline.apply(moved, fps)
+        assert fresh == [] and len(suppressed) == 1
+
+    def test_malformed_baseline_rejected(self, tmp_path):
+        bl = tmp_path / "bl.jsonl"
+        bl.write_text('{"rule": "x"}\n')  # no fingerprint
+        with pytest.raises(ValueError, match="fingerprint"):
+            baseline.load(str(bl))
+
+    def test_missing_baseline_is_empty(self, tmp_path):
+        assert baseline.load(str(tmp_path / "nope.jsonl")) == set()
+
+
+class TestLintReportSeam:
+    def test_record_validates_and_gates_ok(self, tmp_path):
+        bad = tmp_path / "ok.py"
+        bad.write_text("x = 1\n")
+        led = str(tmp_path / "led.jsonl")
+        assert lint_main.main(["source", str(bad), "--no-baseline",
+                               "--ledger", led]) == 0
+        recs = ledger.read(led)
+        assert len(recs) == 1
+        block = recs[0]["lint_report"]
+        assert ledger.validate_lint_report(block) == []
+        assert block["ok"] and block["pass"] == "source"
+        assert obs_main.main(["lint-report", led,
+                              "--require-pass", "source"]) == 0
+
+    def test_failing_report_fails_the_obs_gate(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(BAD_SOURCE)
+        led = str(tmp_path / "led.jsonl")
+        assert lint_main.main(["source", str(bad), "--no-baseline",
+                               "--ledger", led]) == 1
+        assert obs_main.main(["lint-report", led]) == 1
+
+    def test_malformed_record_exits_2(self, tmp_path):
+        led = str(tmp_path / "led.jsonl")
+        ledger.append(led, ledger.record(
+            "lint:report", ledger.manifest(),
+            lint_report={"schema_version": ledger.SCHEMA_VERSION},
+        ))
+        assert obs_main.main(["lint-report", led]) == 2
+
+    def test_required_pass_missing_exits_1(self, tmp_path):
+        led = tmp_path / "led.jsonl"
+        led.write_text("")  # a ledger with no lint_report records
+        assert obs_main.main(["lint-report", str(led),
+                              "--require-pass", "program"]) == 1
+        assert obs_main.main(["lint-report", str(led)]) == 0
+
+    def test_diff_rejects_malformed_lint_record(self):
+        rec = ledger.record("lint:report", ledger.manifest(),
+                            lint_report={"pass": "nope"})
+        with pytest.raises(ledger.LedgerIncompatible, match="lint_report"):
+            ledger.diff([rec], [rec])
+
+
+# ---------------------------------------------------------------------------
+# SolveEngine(validate=True): the donation assert at cache-insert
+# ---------------------------------------------------------------------------
+
+ENGINE_CFG = ServeConfig(
+    buckets=(8, 16),
+    rows_buckets=(32, 64),
+    nrhs_buckets=(1, 4),
+    max_batch=2,
+    max_delay_s=10.0,
+    donate=True,  # CPU honors donation in this jax; exercise the assert
+)
+
+
+class TestEngineValidate:
+    def test_honored_donations_insert_cleanly(self):
+        eng = SolveEngine(cfg=ENGINE_CFG, validate=True)
+        rng = np.random.default_rng(0)
+        M = rng.standard_normal((8, 8))
+        A = M @ M.T + 8 * np.eye(8)
+        B = rng.standard_normal((8, 3))
+        r = eng.solve("posv", A, B)
+        assert r.ok
+        np.testing.assert_allclose(np.asarray(A @ r.x), B, atol=1e-8)
+        r = eng.solve("inv", A)
+        assert r.ok
+
+    def test_lstsq_declares_no_droppable_donation(self):
+        # the (m, nrhs) RHS can never alias the (n, nrhs) solution; the
+        # engine must not declare it, so compiling raises no drop warning
+        eng = SolveEngine(cfg=ENGINE_CFG, validate=True)
+        with warnings.catch_warnings():
+            warnings.simplefilter("error", UserWarning)
+            assert eng.warmup([("lstsq", (24, 8), (24, 1), "float64")]) == 1
+
+    def test_dropped_donation_raises_at_insert(self, monkeypatch):
+        # force the hazard: a posv whose "solution" cannot alias the donated
+        # RHS batch — validate must refuse the cache insert
+        def bad_batched(op, precision):
+            def fn(Ab, Bb):
+                return jnp.sum(Bb, axis=2), jnp.zeros(
+                    Ab.shape[0], jnp.int32)
+            return fn
+
+        monkeypatch.setattr(serve_api, "batched", bad_batched)
+        eng = SolveEngine(cfg=ENGINE_CFG, validate=True)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            with pytest.raises(AssertionError, match="donation"):
+                eng.warmup([("posv", (8, 8), (8, 1), "float64")])
+
+    def test_validate_off_keeps_seed_behavior(self, monkeypatch):
+        def bad_batched(op, precision):
+            def fn(Ab, Bb):
+                return jnp.sum(Bb, axis=2), jnp.zeros(
+                    Ab.shape[0], jnp.int32)
+            return fn
+
+        monkeypatch.setattr(serve_api, "batched", bad_batched)
+        eng = SolveEngine(cfg=ENGINE_CFG)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            assert eng.warmup([("posv", (8, 8), (8, 1), "float64")]) == 1

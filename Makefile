@@ -5,8 +5,8 @@
 
 PY ?= python
 
-.PHONY: all test benchmarking bench-explicit tune audit lint robust \
-	serve-smoke native clean
+.PHONY: all test benchmarking bench-explicit bench-small tune audit lint \
+	robust serve-smoke native clean
 
 all: test
 
@@ -34,6 +34,19 @@ bench-explicit:
 tune:
 	$(PY) -m capital_tpu.autotune cholinv --n 2048 --out autotune_out
 
+# small-N latency smoke (docs/PERF.md round 7): the batched-grid posv and
+# lstsq buckets in --latency mode, per-dispatch p50/p95/p99 wall_ms on the
+# CPU interpret rig, one bench:latency ledger record each.  The absolute
+# numbers are emulation artifacts; what this pins is that the latency
+# protocol, the fused kernels, and the ledger schema all work end to end.
+bench-small:
+	$(PY) -m capital_tpu.bench posv --platform cpu --n 32 --batch 4 \
+		--nrhs 2 --dtype float32 --latency --calls 8 \
+		--small-impl pallas --validate --ledger bench_small.jsonl
+	$(PY) -m capital_tpu.bench lstsq --platform cpu --n 32 --batch 4 \
+		--nrhs 2 --dtype float32 --latency --calls 8 \
+		--small-impl pallas --validate --ledger bench_small.jsonl
+
 # model-vs-compiled drift gate on the flagship configs (docs/OBSERVABILITY.md);
 # compile-only — runs in CI without a TPU (exit non-zero on drift)
 audit: serve-smoke lint
@@ -58,13 +71,16 @@ lint:
 # serving self-check (docs/SERVING.md): mixed-bucket CPU workload through
 # the SolveEngine, one serve:request_stats ledger record, gated on 100%
 # post-warmup cache hit-rate (zero steady-state recompiles) + the pinned
-# per-request residual gates inside the smoke itself
+# per-request residual gates inside the smoke itself.  --max-p99-ms-small
+# gates the small-N (batched-grid pallas) request tail; the generous bound
+# absorbs CPU-interpret emulation — what it pins is that the small path ran
+# and reported (the gate fails loudly if no latency_ms_small block exists)
 serve-smoke:
 	rm -f serve_smoke.jsonl
 	$(PY) -m capital_tpu.serve smoke --platform cpu --requests 50 \
 		--ledger serve_smoke.jsonl
 	$(PY) -m capital_tpu.obs serve-report serve_smoke.jsonl \
-		--min-hit-rate 1.0
+		--min-hit-rate 1.0 --max-p99-ms-small 30000
 
 # breakdown detection / shifted-CholeskyQR recovery / fault-injection suite
 # (docs/ROBUSTNESS.md); CPU rig — tests/conftest.py provides the 8-device
@@ -77,5 +93,5 @@ native:
 
 clean:
 	rm -rf autotune_out .pytest_cache bench_explicit.jsonl serve_smoke.jsonl \
-		lint_report.jsonl
+		lint_report.jsonl bench_small.jsonl
 	find . -name __pycache__ -type d -exec rm -rf {} +

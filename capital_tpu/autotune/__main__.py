@@ -1,4 +1,4 @@
-"""CLI: python -m capital_tpu.autotune {cholinv,cacqr,trsm} [flags]."""
+"""CLI: python -m capital_tpu.autotune {cholinv,cacqr,trsm,small} [flags]."""
 
 from __future__ import annotations
 
@@ -9,7 +9,7 @@ import jax
 
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(prog="capital_tpu.autotune")
-    p.add_argument("alg", choices=["cholinv", "cacqr", "trsm"])
+    p.add_argument("alg", choices=["cholinv", "cacqr", "trsm", "small"])
     p.add_argument("--n", type=int, default=4096)
     p.add_argument("--m", type=int, default=65536)
     p.add_argument("--dtype", default="bfloat16")
@@ -53,6 +53,43 @@ def main(argv=None) -> None:
         help="num_chunks values crossed with each --grids token (the "
         "reference Ibcast/Iallreduce pipeline; the planner prices q since "
         "round 4)",
+    )
+    p.add_argument(
+        "--op", default="posv", choices=["posv", "lstsq"],
+        help="small: which serve op's bucket executables to sweep",
+    )
+    p.add_argument(
+        "--batch", type=int, default=8,
+        help="small: bucket batch capacity (ServeConfig.max_batch)",
+    )
+    p.add_argument(
+        "--nrhs", type=int, default=1,
+        help="small: RHS columns per problem",
+    )
+    p.add_argument(
+        "--buckets", type=int, nargs="+", default=None,
+        help="small: bucket n ladder to sweep, one latency sweep per "
+        "bucket (default: 16 32 64 128)",
+    )
+    p.add_argument(
+        "--occupancy", type=float, default=1.0,
+        help="small: fixed batch occupancy the latency is measured at "
+        "(real problems / capacity; the tail is identity fill, exactly a "
+        "serve flush at that occupancy)",
+    )
+    p.add_argument(
+        "--impls", nargs="+", default=None,
+        choices=["vmap", "pallas", "pallas_split"],
+        help="small: implementation axis (default all three)",
+    )
+    p.add_argument(
+        "--blocks", type=int, nargs="+", default=None,
+        help="small: column-block unroll axis for the pallas impls "
+        "(0 = pick_block default)",
+    )
+    p.add_argument(
+        "--calls", type=int, default=32,
+        help="small: per-config latency samples (harness.latency_samples)",
     )
     p.add_argument("--devices", type=int, default=0)
     p.add_argument("--platform", default=None)
@@ -164,6 +201,50 @@ def main(argv=None) -> None:
             grid, args.n, nrhs, dtype, args.out,
             checkpoint=args.resume, ledger=args.ledger, **space,
         )
+    elif args.alg == "small":
+        # latency-mode sweep, one per bucket: the objective is per-bucket
+        # p99 wall_ms at fixed occupancy, so each bucket n gets its own
+        # run_sweep (own checkpoint, own best.json overwritten per bucket
+        # is avoided by nesting out dirs per bucket)
+        for flag, given in (
+            ("--grids", "grids" in space),
+            ("--splits", bool(args.splits)),
+            ("--policies", bool(args.policies)),
+            ("--top-k", args.top_k != 0),
+            ("--modes", bool(args.modes)),
+            ("--bc", bool(args.bc)),
+        ):
+            if given:
+                p.error(
+                    f"{flag} is not a small sweep axis (impl x block per "
+                    "bucket only)"
+                )
+        space = {}
+        if args.impls:
+            space["impls"] = tuple(args.impls)
+        if args.blocks:
+            space["blocks"] = tuple(args.blocks)
+        grid = Grid.square(c=1, devices=dev[:1])
+        buckets = args.buckets or [16, 32, 64, 128]
+        import os
+
+        res = []
+        for n in buckets:
+            out_n = os.path.join(args.out, f"n{n}")
+            rs = sweep.tune_small(
+                grid, args.op, n, batch=args.batch, nrhs=args.nrhs,
+                dtype=dtype, out_dir=out_n, occupancy=args.occupancy,
+                calls=args.calls, checkpoint=args.resume,
+                ledger=args.ledger, **space,
+            )
+            b = rs[0]
+            p99 = (b.extra or {}).get("wall_ms", {}).get("p99")
+            print(
+                f"bucket n={n}: best {b.config_id}  p99 {p99} ms  "
+                f"-> {out_n}/"
+            )
+            res.extend(rs)
+        res.sort(key=lambda r: r.seconds)
     else:
         grid = Grid.flat(devices=dev)
         res = sweep.tune_cacqr(grid, args.m, args.n if args.n < args.m else 512,

@@ -47,6 +47,10 @@ class SweepResult:
     config: dict
     seconds: float
     recorder: tracing.Recorder
+    #: measurement-protocol sidecar (e.g. latency_measure's wall_ms
+    #: percentile block); merged into the ledger's measured dict.  None
+    #: under the default amortized protocol.
+    extra: dict | None = None
 
 
 # --------------------------------------------------------------------------
@@ -134,6 +138,9 @@ def _ckpt_load(path: str, key: dict) -> dict:
                 "config": entry.get("config", {}),
                 "seconds": float(entry["seconds"]),
                 "stats": entry.get("stats", {}),
+                # protocol sidecar (latency percentiles): optional, rides
+                # the resume so a resumed latency sweep keeps its wall_ms
+                "extra": entry.get("extra"),
             }
         else:
             print(
@@ -189,9 +196,20 @@ def run_sweep(
     key_extra: dict | None = None,
     ledger: str | None = None,
     retry: harness.RetryPolicy = harness.RetryPolicy(),
+    measure: Callable | None = None,
 ) -> list[SweepResult]:
     """Measure + model every (config_id, config_dict, step_fn) and write the
     cost tables.  Returns results sorted best-first by measured time.
+
+    `measure` swaps the measurement protocol: a callable
+    ``measure(step, operand) -> (seconds, extra_dict | None)`` replacing
+    the default amortized ``harness.timed_loop`` (which `iters` feeds).
+    The returned seconds is what the sweep SORTS on — a latency protocol
+    returning p99 wall seconds (latency_measure) makes the sweep optimize
+    p99, not mean throughput — and extra_dict rides the SweepResult, the
+    checkpoint, and the ledger's measured block (e.g. the full wall_ms
+    percentile split).  Containment is identical either way: the call runs
+    under run_guarded with the same retry policy.
 
     checkpoint=True persists per-config results to a problem-keyed
     ``<out_dir>/<name>_sweep_<hash>.json`` after each measurement; a re-run
@@ -236,18 +254,27 @@ def run_sweep(
             results.append(
                 SweepResult(
                     cid, entry["config"], entry["seconds"],
-                    _recorder_from(entry["stats"]),
+                    _recorder_from(entry["stats"]), entry.get("extra"),
                 )
             )
             print(f"# autotune {name}: {cid}  {entry['seconds'] * 1e3:.3f} ms (resumed)")
             continue
         rec = _model_costs(step, operand)
+        extra_m: dict | None = None
         try:
-            secs, attempts = harness.run_guarded(
-                lambda: harness.timed_loop(step, operand, iters=iters),
-                policy=retry,
-                label=f"{name}:{cid}",
-            )
+            if measure is None:
+                secs, attempts = harness.run_guarded(
+                    lambda: harness.timed_loop(step, operand, iters=iters),
+                    policy=retry,
+                    label=f"{name}:{cid}",
+                )
+            else:
+                out, attempts = harness.run_guarded(
+                    lambda: measure(step, operand),
+                    policy=retry,
+                    label=f"{name}:{cid}",
+                )
+                secs, extra_m = out
         except harness.MeasurementUnresolved as e:
             # below the measurement noise floor: record nothing for this
             # config rather than aborting the sweep and losing the rest
@@ -269,12 +296,14 @@ def run_sweep(
             continue
         if attempts > 1:
             attempts_by[cid] = attempts
-        results.append(SweepResult(cid, cdict, secs, rec))
+        results.append(SweepResult(cid, cdict, secs, rec, extra_m))
         print(f"# autotune {name}: {cid}  {secs * 1e3:.3f} ms")
         if checkpoint:
             done[cid] = {
                 "config": cdict, "seconds": secs, "stats": _recorder_dump(rec),
             }
+            if extra_m is not None:
+                done[cid]["extra"] = extra_m
             _ckpt_save(ckpt_path, key, done)
 
     os.makedirs(out_dir, exist_ok=True)
@@ -341,6 +370,10 @@ def run_sweep(
                         "value": round(1.0 / r.seconds, 4),
                         "unit": "iter/s",
                         "seconds": r.seconds,
+                        # protocol sidecar: a latency sweep lands its
+                        # wall_ms percentile block here, so per-bucket
+                        # p99 is queryable straight off the ledger
+                        **(r.extra or {}),
                     },
                     **({"event": ev} if ev else {}),
                 ),
@@ -558,6 +591,153 @@ def trsm_space(
             {"base_case_dim": bc, "leaf": leaf, "mode": mode},
             step,
         )
+
+
+def latency_measure(calls: int = 32, warmup: int = 3) -> Callable:
+    """Measurement protocol for `run_sweep(measure=...)`: per-call wall
+    time (harness.latency_samples — one dispatch + one device round-trip
+    per sample, the cost a served request actually pays, NOT timed_loop's
+    in-jit amortized body), sorted on **p99**.  Returns
+    ``(p99_seconds, {"wall_ms": {"p50": .., "p95": .., "p99": ..}})`` so
+    the sweep crowns the config with the best tail latency and the full
+    percentile split rides the checkpoint and the ledger."""
+
+    def measure(step, operand):
+        fn = jax.jit(step)
+        samples = harness.latency_samples(
+            lambda: fn(operand), calls=calls, warmup=warmup
+        )
+        pcts = harness.percentiles(samples)
+        return pcts["p99"], {
+            "wall_ms": {k: round(v * 1e3, 4) for k, v in pcts.items()}
+        }
+
+    return measure
+
+
+def batched_small_space(
+    op: str,
+    n: int,
+    B_rhs,
+    dtype,
+    impls: Iterable[str] = ("vmap", "pallas", "pallas_split"),
+    blocks: Iterable[int] = (0,),
+):
+    """impl x block for the batched small-N kernel layer (ops/
+    batched_small): the serve dispatch alternatives measured against each
+    other — vmap-over-LAPACK (the pure-XLA fallback, no block axis),
+    the fused batched-grid kernel, and the unfused two-launch split
+    (posv only; the A/B that isolates the fusion win from the
+    batched-grid win).  `B_rhs` is the bucket's RHS batch, closed over so
+    the swept operand stays the single A array run_sweep's manifest and
+    checkpoint key expect."""
+    from capital_tpu.ops import batched_small
+    from capital_tpu.serve import api
+
+    prec = None if jnp.dtype(dtype).itemsize < 4 else "highest"
+    for impl in impls:
+        if impl == "vmap":
+            fn = api.batched(op, prec, "vmap")
+
+            def step(a, fn=fn):
+                return fn(a, B_rhs)
+
+            yield "vmap", {"impl": "vmap"}, step
+            continue
+        if impl == "pallas_split" and op == "lstsq":
+            continue  # lstsq has no split form (api.batched routes it fused)
+        for blk in blocks:
+            blk_eff = blk or batched_small.pick_block(n)
+            if impl == "pallas":
+                if op == "posv":
+                    def step(a, blk=blk):
+                        return batched_small.posv(
+                            a, B_rhs, block=blk, precision=prec
+                        )
+                else:
+                    def step(a, blk=blk):
+                        return batched_small.lstsq(
+                            a, B_rhs, block=blk, precision=prec
+                        )
+            else:
+                def step(a, blk=blk):
+                    R, info = batched_small.potrf(
+                        a, uplo="U", block=blk, precision=prec
+                    )
+                    X = batched_small.potrs(
+                        R, B_rhs, uplo="U", block=blk, precision=prec
+                    )
+                    return X, info
+
+            yield (
+                f"{impl}_b{blk_eff}",
+                {"impl": impl, "block": blk_eff},
+                step,
+            )
+
+
+def tune_small(
+    grid: Grid,
+    op: str,
+    n: int,
+    batch: int = 8,
+    nrhs: int = 1,
+    dtype=jnp.float32,
+    out_dir: str = "autotune_out",
+    occupancy: float = 1.0,
+    rows: int | None = None,
+    calls: int = 32,
+    warmup: int = 3,
+    checkpoint: bool = False,
+    ledger: str | None = None,
+    **space,
+) -> list[SweepResult]:
+    """Latency-mode sweep for ONE serve bucket: impl x block measured by
+    per-call p99 wall time (latency_measure) at a FIXED batch occupancy —
+    the serving objective, not peak TFLOP/s.  The operand batch carries
+    ``round(occupancy * batch)`` real problems and identity fill for the
+    tail, exactly the mixture a `serve` bucket flushes at that occupancy
+    (batching.assemble's fill problems), so the crowned config is tuned
+    for the batches production actually runs.  Results/checkpoints/ledger
+    all ride run_sweep: resumable per-config, per-bucket p99 wall_ms in
+    every autotune:small_<op> measured block."""
+    import numpy as np
+
+    if op not in ("posv", "lstsq"):
+        raise ValueError(
+            f"tune_small: op must be 'posv' or 'lstsq', got {op!r}"
+        )
+    if not 0.0 < occupancy <= 1.0:
+        raise ValueError(f"tune_small: occupancy {occupancy} outside (0, 1]")
+    real = max(1, round(occupancy * batch))
+    rng = np.random.default_rng(2)
+    if op == "posv":
+        m_rows = n
+        X = rng.standard_normal((batch, n, n))
+        A = X @ X.transpose(0, 2, 1) / n + 3.0 * np.eye(n)
+        A[real:] = np.eye(n)
+    else:
+        m_rows = rows if rows is not None else 4 * n
+        A = rng.standard_normal((batch, m_rows, n))
+        A[real:] = np.eye(m_rows, n)
+    B = rng.standard_normal((batch, m_rows, nrhs))
+    B[real:] = 0.0  # fill problems: zero RHS -> exact-zero solutions
+    A = jax.block_until_ready(jnp.asarray(A, dtype))
+    B = jax.block_until_ready(jnp.asarray(B, dtype))
+    return run_sweep(
+        f"small_{op}",
+        batched_small_space(op, n, B, dtype, **space),
+        A,
+        out_dir,
+        dtype=dtype,
+        checkpoint=checkpoint,
+        key_extra={
+            **_grid_key(grid), "op": op, "n": n, "batch": batch,
+            "nrhs": nrhs, "occupancy": occupancy, "calls": calls,
+        },
+        ledger=ledger,
+        measure=latency_measure(calls=calls, warmup=warmup),
+    )
 
 
 def tune_trsm(

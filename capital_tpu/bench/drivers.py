@@ -767,6 +767,131 @@ def trsm(args) -> dict:
     return rec
 
 
+def _small_batch(op: str, n: int, batch: int, nrhs: int, dtype,
+                 seed: int = 3):
+    """One bucket-shaped problem batch for the small-N drivers: SPD
+    problems for posv, tall (4n, n) problems for lstsq — the serve
+    bucket geometry, full occupancy."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    if op == "posv":
+        m = n
+        X = rng.standard_normal((batch, n, n))
+        A = X @ X.transpose(0, 2, 1) / n + 3.0 * np.eye(n)
+    else:
+        m = 4 * n
+        A = rng.standard_normal((batch, m, n))
+    B = rng.standard_normal((batch, m, nrhs))
+    return (
+        jax.block_until_ready(jnp.asarray(A, dtype)),
+        jax.block_until_ready(jnp.asarray(B, dtype)),
+    )
+
+
+def _small_residual(op: str, A, B, X) -> float:
+    """Worst per-problem f64 residual of a batch solve (numpy reference)."""
+    import numpy as np
+
+    A = np.asarray(A, np.float64)
+    B = np.asarray(B, np.float64)
+    X = np.asarray(X, np.float64)
+    worst = 0.0
+    for i in range(A.shape[0]):
+        if op == "posv":
+            r = np.linalg.norm(A[i] @ X[i] - B[i]) / np.linalg.norm(B[i])
+        else:
+            num = np.linalg.norm(A[i].T @ (A[i] @ X[i] - B[i]))
+            r = num / np.linalg.norm(A[i].T @ B[i])
+        worst = max(worst, r)
+    return worst
+
+
+def _small_solve(args, op: str):
+    """Shared body of the posv/lstsq small-N drivers: one bucket batch
+    through api.batched under --small-impl, measured either amortized
+    (TFLOP/s row, the default) or per-call (--latency: p50/p95/p99
+    wall_ms via harness.latency_samples + percentiles, sorted facts for
+    the latency regime ROADMAP item 5 names — each sample pays the
+    dispatch a served request pays)."""
+    from capital_tpu.serve import api
+
+    dtype = jnp.dtype(args.dtype)
+    n, batch, nrhs = args.n, args.batch, args.nrhs
+    grid = Grid.square(c=1, devices=jax.devices()[:1])
+    prec = _precision(args, dtype)
+    A, B = _small_batch(op, n, batch, nrhs, dtype)
+    fn = jax.jit(api.batched(op, prec, args.small_impl))
+
+    if args.validate:
+        X, info = jax.block_until_ready(fn(A, B))
+        bad = int(jnp.sum(info != 0))
+        if bad:
+            sys.exit(f"validation failed: {bad} problem(s) report info != 0")
+        tol = _tolerance(dtype)
+        gate = 10 * tol if op == "lstsq" else tol
+        _gate(f"{op}_batch_residual", _small_residual(op, A, B, X), gate)
+
+    # useful flops (not the kernels' executed sweep counts): the
+    # cross-impl comparable figure
+    m = A.shape[1]
+    if op == "posv":
+        flops = batch * (n**3 / 3.0 + 2.0 * n * n * nrhs)
+    else:
+        flops = batch * (2.0 * m * n * n + 2.0 * m * n * nrhs)
+
+    if args.latency:
+        samples = harness.latency_samples(
+            lambda: fn(A, B), calls=args.calls, warmup=3
+        )
+        pcts = harness.percentiles(samples)
+        wall_ms = {k: round(v * 1e3, 4) for k, v in pcts.items()}
+        from capital_tpu.obs.ledger import SCHEMA_VERSION
+
+        rec = {
+            "metric": f"small_{op}_latency",
+            "schema_version": SCHEMA_VERSION,
+            # value is rate so an obs diff value-drop reads as "slower p99"
+            "value": round(1.0 / pcts["p99"], 3),
+            "unit": "batch/s",
+            "seconds": pcts["p99"],
+            "wall_ms": wall_ms,
+            "dtype": str(dtype),
+            "device": jax.devices()[0].device_kind,
+            "platform": jax.default_backend(),
+            "n": n, "batch": batch, "nrhs": nrhs,
+            "impl": args.small_impl, "calls": args.calls,
+        }
+        import json as _json
+
+        print(_json.dumps(rec))
+        _ledger_append(args, rec, name="latency", grid=grid, dtype=dtype,
+                       cfg={"op": op, "impl": args.small_impl})
+        return rec
+
+    samples = harness.latency_samples(
+        lambda: fn(A, B), calls=max(args.iters, 3), warmup=3
+    )
+    t = sum(samples) / len(samples)
+    rec = harness.report(
+        f"small_{op}_tflops", t, flops, dtype, n=n, batch=batch, nrhs=nrhs,
+        impl=args.small_impl, grid=repr(grid),
+        wall_ms={k: round(v * 1e3, 4)
+                 for k, v in harness.percentiles(samples).items()},
+    )
+    _ledger_append(args, rec, name=op, grid=grid, dtype=dtype,
+                   cfg={"op": op, "impl": args.small_impl})
+    return rec
+
+
+def posv(args):
+    return _small_solve(args, "posv")
+
+
+def lstsq(args):
+    return _small_solve(args, "lstsq")
+
+
 DRIVERS = {
     "cholinv": cholinv,
     "cacqr": cacqr,
@@ -775,6 +900,8 @@ DRIVERS = {
     "newton": newton,
     "spd_inverse": spd_inverse,
     "trsm": trsm,
+    "posv": posv,
+    "lstsq": lstsq,
 }
 
 
@@ -849,6 +976,31 @@ def build_parser() -> argparse.ArgumentParser:
         "scalars ride the report and the ledger record",
     )
     p.add_argument("--validate", action="store_true")
+    p.add_argument(
+        "--batch", type=int, default=8,
+        help="posv/lstsq: problems per bucket batch (serve max_batch)",
+    )
+    p.add_argument(
+        "--nrhs", type=int, default=1,
+        help="posv/lstsq: RHS columns per problem",
+    )
+    p.add_argument(
+        "--latency", action="store_true",
+        help="posv/lstsq: per-call latency mode — p50/p95/p99 wall_ms via "
+        "harness.latency_samples/percentiles (one dispatch per sample, the "
+        "serving protocol) and a bench:latency ledger record, instead of "
+        "the amortized TFLOP/s row",
+    )
+    p.add_argument(
+        "--calls", type=int, default=32,
+        help="posv/lstsq --latency: number of per-call samples",
+    )
+    p.add_argument(
+        "--small-impl", default="auto",
+        choices=["auto", "vmap", "pallas", "pallas_split"],
+        help="posv/lstsq: batched implementation (api.batched impl switch; "
+        "auto resolves from the bucket shape like serve does)",
+    )
     p.add_argument(
         "--ledger", default=None,
         help="append one unified obs ledger record per run (manifest + "

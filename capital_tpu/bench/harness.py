@@ -164,6 +164,29 @@ def percentiles(
     return out
 
 
+def latency_samples(fn, calls: int = 32, warmup: int = 3) -> list[float]:
+    """Per-call wall seconds of `fn()` — the SERVING-latency protocol, the
+    deliberate opposite of timed_loop's in-jit amortized one: each sample
+    is one dispatch + one device round-trip (block_until_ready), because a
+    served request pays exactly that, and a p99 over amortized loop bodies
+    would hide the dispatch tail a latency SLO exists to catch.  Compile
+    time stays out via the warmup calls.  Feed the result to
+    `percentiles()` — the shared quantile rule keeps a bench latency row
+    and a serve request_stats record on one scale."""
+    import time
+
+    if calls < 1:
+        raise ValueError(f"latency_samples needs calls >= 1, got {calls}")
+    for _ in range(max(warmup, 1)):
+        jax.block_until_ready(fn())
+    samples = []
+    for _ in range(calls):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        samples.append(time.perf_counter() - t0)
+    return samples
+
+
 def _resolve_delta(
     run, k: int, cap: int, repeats: int, noise: float, samples_out=None
 ) -> tuple[float, float, int]:

@@ -91,7 +91,12 @@ class ProgramTarget:
     jit — the donation rule checks the executable honors it.  ``cacheable``
     marks programs destined for an AOT executable cache (enables
     cache-key-hygiene); ``hot_path`` marks per-request/steady-state
-    programs (enables no-host-sync)."""
+    programs (enables no-host-sync).  ``flops_audited=False`` exempts the
+    target from the whole-program flops envelope only — for programs whose
+    compute lives inside interpret-mode ``pallas_call`` bodies, which XLA's
+    ``cost_analysis`` cannot see on the CPU lint rig (the model prices the
+    executed kernel flops; the emulated HLO reports ~none).  The collective
+    side of the budget rule still runs."""
 
     name: str
     fn: Callable
@@ -99,6 +104,7 @@ class ProgramTarget:
     donate_argnums: tuple[int, ...] = ()
     cacheable: bool = True
     hot_path: bool = True
+    flops_audited: bool = True
 
     @property
     def target(self) -> str:
@@ -330,7 +336,7 @@ def rule_collective_budget(
         )
         for p in rep.phases if p.classification == xla_audit.UNDERCOUNT
     ]
-    if not rep.flops_within:
+    if not rep.flops_within and tgt.flops_audited:
         out.append(rules.make(
             COLLECTIVE_BUDGET, rules.WARN, tgt.target,
             f"whole-program flops drift: model {rep.model_flops:.3e} vs "

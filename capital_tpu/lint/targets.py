@@ -20,7 +20,7 @@ import jax.numpy as jnp
 
 from capital_tpu.lint.program import ProgramTarget
 
-TARGET_NAMES = ("cholinv", "cacqr", "serve")
+TARGET_NAMES = ("cholinv", "cacqr", "serve", "batched_small")
 
 
 def _grid():
@@ -95,6 +95,49 @@ def serve_bucket_targets(
     ]
 
 
+def batched_small_targets(
+    n: int = 64, rows: int = 256, nrhs: int = 4, capacity: int = 8,
+    dtype=jnp.float32,
+) -> list[ProgramTarget]:
+    """Batched-grid small-N bucket programs (ops/batched_small), built the
+    way serve/engine._get_batched builds them when ServeConfig.small_n_impl
+    routes pallas: the fused posv and lstsq buckets plus the split
+    potrf+potrs variant the autotune sweeps against them.
+
+    No donation is declared: the kernels' RHS aliasing lives inside the
+    ``pallas_call`` (``input_output_aliases``), which the CPU lint rig's
+    interpret mode drops entirely — declaring a jit-level donation here
+    would make the donation-honored rule fail for a platform reason, not a
+    program one.  ``flops_audited=False`` for the same reason: the kernel
+    flops execute inside the interpreted ``pallas_call``, invisible to
+    XLA ``cost_analysis``, so the whole-program flops envelope would flag
+    the rig rather than the program (ProgramTarget docstring)."""
+    from capital_tpu.serve import api
+
+    dt = jnp.dtype(dtype)
+    a_sq = jax.ShapeDtypeStruct((capacity, n, n), dt)
+    b_sq = jax.ShapeDtypeStruct((capacity, n, nrhs), dt)
+    a_tall = jax.ShapeDtypeStruct((capacity, rows, n), dt)
+    b_tall = jax.ShapeDtypeStruct((capacity, rows, nrhs), dt)
+    mk = f"b{capacity}-n{n}"
+    return [
+        ProgramTarget(
+            name=f"small-posv-{mk}", fn=api.batched("posv", impl="pallas"),
+            args=(a_sq, b_sq), flops_audited=False,
+        ),
+        ProgramTarget(
+            name=f"small-posv-split-{mk}",
+            fn=api.batched("posv", impl="pallas_split"),
+            args=(a_sq, b_sq), flops_audited=False,
+        ),
+        ProgramTarget(
+            name=f"small-lstsq-{mk}-m{rows}",
+            fn=api.batched("lstsq", impl="pallas"),
+            args=(a_tall, b_tall), flops_audited=False,
+        ),
+    ]
+
+
 def flagship_targets(names=None) -> list[ProgramTarget]:
     """The `make lint` program-pass set.  `names` filters to a subset of
     TARGET_NAMES (all three families by default)."""
@@ -107,6 +150,8 @@ def flagship_targets(names=None) -> list[ProgramTarget]:
             out.append(cacqr_target())
         elif name == "serve":
             out.extend(serve_bucket_targets())
+        elif name == "batched_small":
+            out.extend(batched_small_targets())
         else:
             raise ValueError(
                 f"unknown lint target {name!r}; expected one of {TARGET_NAMES}"

@@ -205,17 +205,25 @@ def _serve_report(args) -> int:
             bad += 1
     if bad:
         return 2
-    gates_on = args.min_hit_rate is not None or args.max_p99_ms is not None
+    gates_on = (args.min_hit_rate is not None
+                or args.max_p99_ms is not None
+                or args.max_p99_ms_small is not None)
     if not rows:
         print(f"# no request_stats records in {args.ledger} "
               f"({len(recs)} records total)")
         return 1 if gates_on else 0
     failures = []
+    small_seen = 0
     for i, r in enumerate(rows):
         rs = r["request_stats"]
         man = r.get("manifest") or {}
         cache = rs["cache"]
         lat = rs["latency_ms"]
+        lat_small = rs.get("latency_ms_small")
+        small_note = (
+            f" small requests={rs.get('requests_small', 0)} "
+            f"p99={lat_small['p99']}" if lat_small else ""
+        )
         print(
             f"# [{i}] {man.get('platform', '?')}/{man.get('device', '?')} "
             f"requests={rs['requests']} ok={rs['ok']} "
@@ -224,7 +232,7 @@ def _serve_report(args) -> int:
             f"occupancy={rs['batch_occupancy_mean']} "
             f"queue_max={rs['queue_depth_max']} "
             f"cache hits={cache['hits']} misses={cache['misses']} "
-            f"hit_rate={cache['hit_rate']:.3f}"
+            f"hit_rate={cache['hit_rate']:.3f}" + small_note
         )
         if (args.min_hit_rate is not None
                 and cache["hit_rate"] < args.min_hit_rate):
@@ -236,6 +244,21 @@ def _serve_report(args) -> int:
             failures.append(
                 f"record #{i}: p99 {lat['p99']}ms > {args.max_p99_ms}ms"
             )
+        if lat_small is not None:
+            small_seen += 1
+            if (args.max_p99_ms_small is not None
+                    and lat_small["p99"] > args.max_p99_ms_small):
+                failures.append(
+                    f"record #{i}: small-bucket p99 {lat_small['p99']}ms > "
+                    f"{args.max_p99_ms_small}ms"
+                )
+    if args.max_p99_ms_small is not None and not small_seen:
+        # same posture as gates-with-no-records: a requested gate that
+        # nothing exercised is a silently-dead gate, so it fails loudly.
+        failures.append(
+            "--max-p99-ms-small requested but no record carries a "
+            "latency_ms_small block (no small-bucket traffic served?)"
+        )
     for f in failures:
         print(f"serve-report gate FAIL: {f}", file=sys.stderr)
     if failures:
@@ -370,6 +393,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="fail unless every record's cache hit_rate >= this")
     s.add_argument("--max-p99-ms", type=float, default=None,
                    help="fail when any record's p99 latency exceeds this")
+    s.add_argument("--max-p99-ms-small", type=float, default=None,
+                   help="gate the small-N bucket latency split separately: "
+                        "fail when any record's latency_ms_small.p99 "
+                        "exceeds this, or when no record carries the split")
     s.set_defaults(fn=_serve_report)
 
     lr = sub.add_parser(

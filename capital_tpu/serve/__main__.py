@@ -87,6 +87,12 @@ def _smoke(args) -> int:
         nrhs_buckets=(1, 4),
         max_batch=4,
         max_delay_s=0.01,
+        # every smoke bucket is <= batched_small.SMALL_N_MAX, so 'auto'
+        # routes the posv/lstsq buckets through the fused batched-grid
+        # kernels (interpret mode on CPU) — the smoke exercises the same
+        # dispatch a TPU deployment gets, and latency_ms_small lands in
+        # the record for the --max-p99-ms-small serve-report gate.
+        small_n_impl=args.small_n_impl,
     )
     eng = SolveEngine(cfg=cfg)
     work = _workload(args.requests, args.seed)
@@ -171,6 +177,10 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--ledger", default=None,
                    help="append the request_stats record to this JSONL file")
     s.add_argument("--platform", default=None)
+    s.add_argument("--small-n-impl", default="auto",
+                   choices=("auto", "vmap", "pallas", "pallas_split"),
+                   help="batched implementation for the bucket executables "
+                        "(ServeConfig.small_n_impl; docs/SERVING.md)")
     s.set_defaults(fn=_smoke)
     return p
 

@@ -2,23 +2,39 @@
 
 Two routes per op, chosen by the engine:
 
-* **batched** — a vmap over per-problem kernels built directly on the
-  LAPACK seam (ops/lapack) and lax.linalg, which batch natively.  The
-  models/ schedules are NOT vmapped: they carry sharding constraints and
-  trace-time cost-model emits sized for one distributed problem, neither of
-  which means anything replicated over a batch axis.  The batched kernels
-  are the same math at the same >= f32 compute-dtype discipline:
+* **batched** — the whole bucket batch in one program, with TWO
+  interchangeable implementations behind the `impl` switch:
 
-      posv   potrf(A) + the two-trsm potrs sweeps        (lapack.potrs)
-      lstsq  CholeskyQR2 on the gram + triangular solve  (the CQR2 pipeline
-             of models/qr.py collapsed to its single-problem form)
-      inv    potrf_trtri + R⁻¹·R⁻ᵀ                       (spd_inverse's core)
+  - ``vmap`` — a vmap over per-problem kernels built directly on the
+    LAPACK seam (ops/lapack) and lax.linalg, which batch natively.  The
+    models/ schedules are NOT vmapped: they carry sharding constraints and
+    trace-time cost-model emits sized for one distributed problem, neither
+    of which means anything replicated over a batch axis:
+
+        posv   potrf(A) + the two-trsm potrs sweeps        (lapack.potrs)
+        lstsq  CholeskyQR2 on the gram + triangular solve  (the CQR2
+               pipeline of models/qr.py collapsed to single-problem form)
+        inv    potrf_trtri + R⁻¹·R⁻ᵀ                       (spd_inverse's
+               core)
+
+  - ``pallas`` — the batched-grid kernels of ops/batched_small: ONE
+    pallas_call with the batch axis on the grid, factor kept VMEM-resident
+    between factor and solve (fused posv / fused CQR2 lstsq).  This is the
+    small-N latency path; ``pallas_split`` is its unfused two-call variant
+    (separate factor and solve launches — the A/B reference the latency
+    autotune measures the fusion win against; lstsq has no split form and
+    routes to the fused kernel).  ``auto`` resolves per bucket at trace
+    time from the STATIC batch shapes (batched_small.default_impl: pallas
+    iff posv/lstsq, n <= SMALL_N_MAX and VMEM-eligible, else vmap) — no
+    runtime value feeds the choice, so the engine's zero-recompile
+    invariant is untouched.  inv always takes vmap.
 
   Every batched kernel returns (X, info) with info the per-problem int32
-  breakdown status (robust/detect via lapack's with_info paths) — detection
-  is O(n²) against the O(n³) solve, so it is always on; the engine decides
-  whether to surface it (ServeConfig.robust) or let NaNs pass like the raw
-  lax paths would.
+  breakdown status — LAPACK with_info on the vmap path, the in-kernel
+  O(n²) pivot/off-diagonal checks on the pallas paths (same 0/k/n+1
+  convention, robust/detect.factor_info) — detection is O(n²) against the
+  O(n³) solve, so it is always on; the engine decides whether to surface
+  it (ServeConfig.robust) or let NaNs pass like the raw lax paths would.
 
 * **single** — oversize requests (beyond every bucket ladder) run unbatched
   through the REAL models/ paths (cholesky.solve, qr.factor + triangular
@@ -34,7 +50,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from capital_tpu.models import cholesky, qr
-from capital_tpu.ops import lapack
+from capital_tpu.ops import batched_small, lapack
 from capital_tpu.parallel import summa
 from capital_tpu.utils import tracing
 
@@ -89,14 +105,66 @@ def _one_inv(precision):
     return f
 
 
-def batched(op: str, precision: str | None = "highest"):
-    """The function the engine AOT-compiles for one bucket: maps the fixed
-    (capacity, *problem) batch through the per-problem kernel, returning
-    (X, info) stacks."""
+def _batched_vmap(op: str, precision):
+    """The vmap-over-LAPACK batch program: correctness reference and
+    pure-XLA fallback for the pallas paths."""
     if op == "inv":
         return jax.vmap(_one_inv(precision))
     one = {"posv": _one_posv, "lstsq": _one_lstsq}[op](precision)
     return jax.vmap(one)
+
+
+def _batched_pallas(op: str, precision, split: bool):
+    """The batched-grid route: whole bucket batch in one (fused) or two
+    (split) pallas_calls.  Resolution happened at trace time on static
+    shapes, so the returned callable is shape-monomorphic like the vmap
+    one — the engine AOT-compiles it per bucket exactly the same way."""
+    if op == "lstsq":
+        def f(a, b):
+            return batched_small.lstsq(a, b, precision=precision)
+        return f
+    if split:
+        def f(a, b):
+            R, info = batched_small.potrf(a, uplo="U", precision=precision)
+            return batched_small.potrs(R, b, uplo="U",
+                                       precision=precision), info
+        return f
+
+    def f(a, b):
+        return batched_small.posv(a, b, uplo="U", precision=precision)
+    return f
+
+
+def batched(op: str, precision: str | None = "highest",
+            impl: str = "auto"):
+    """The function the engine AOT-compiles for one bucket: maps the fixed
+    (capacity, *problem) batch through the per-problem kernel, returning
+    (X, info) stacks.
+
+    `impl` picks the batch program: 'vmap' (LAPACK-seam reference),
+    'pallas' (fused batched-grid kernels), 'pallas_split' (unfused
+    batched-grid factor + solve, two launches), or 'auto' (resolve per
+    bucket from the static batch shapes at trace time — small VMEM-
+    eligible posv/lstsq buckets go pallas, everything else vmap).
+    """
+    if impl not in batched_small.IMPLS:
+        raise ValueError(
+            f"unknown batched impl {impl!r}: expected one of "
+            f"{batched_small.IMPLS}"
+        )
+    if op == "inv" or impl == "vmap":
+        return _batched_vmap(op, precision)
+    if impl in ("pallas", "pallas_split"):
+        return _batched_pallas(op, precision, split=(impl == "pallas_split"))
+
+    def auto(a, b):
+        b_shape = getattr(b, "shape", None)
+        pick = batched_small.default_impl(op, a.shape, b_shape, a.dtype)
+        if pick == "vmap":
+            return _batched_vmap(op, precision)(a, b)
+        return _batched_pallas(op, precision, split=False)(a, b)
+
+    return auto
 
 
 def single(op: str, grid, precision: str | None = "highest", robust=None):

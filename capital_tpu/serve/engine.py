@@ -49,6 +49,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from capital_tpu.ops import batched_small
 from capital_tpu.parallel.topology import Grid
 from capital_tpu.robust import faultinject
 from capital_tpu.robust.config import RobustConfig, RobustInfo
@@ -75,6 +76,13 @@ class ServeConfig:
     oversize: 'models' routes beyond-ladder requests through the unbatched
         models/ paths; 'reject' fails them (a hard-real-time posture where
         an unexpected compile is worse than an error).
+    small_n_impl: which batched implementation the bucket executables use
+        (serve/api.batched): 'auto' resolves per bucket at trace time
+        (small VMEM-eligible posv/lstsq buckets take the fused batched-
+        grid pallas kernels of ops/batched_small, the rest vmap-over-
+        LAPACK); 'vmap' / 'pallas' / 'pallas_split' force one route for
+        every bucket.  Joins the config hash — two engines differing here
+        compile different programs and must never share cache entries.
     """
 
     buckets: tuple[int, ...] = (256, 512, 1024)
@@ -86,6 +94,7 @@ class ServeConfig:
     robust: Optional[RobustConfig] = None
     donate: Optional[bool] = None
     oversize: str = "models"
+    small_n_impl: str = "auto"
 
 
 @dataclasses.dataclass
@@ -147,6 +156,11 @@ class SolveEngine:
                  validate: bool = False):
         if cfg.oversize not in ("models", "reject"):
             raise ValueError(f"unknown oversize policy {cfg.oversize!r}")
+        if cfg.small_n_impl not in batched_small.IMPLS:
+            raise ValueError(
+                f"unknown small_n_impl {cfg.small_n_impl!r}: expected one "
+                f"of {batched_small.IMPLS}"
+            )
         self.grid = grid or Grid.square(c=1, devices=jax.devices()[:1])
         self.cfg = cfg
         # validate: run the lint donation-honored rule on every executable at
@@ -165,7 +179,8 @@ class SolveEngine:
         # padding geometry — two engines differing here must never share
         # cache entries, and the key makes that structural.
         ident = repr((cfg.buckets, cfg.rows_buckets, cfg.nrhs_buckets,
-                      cfg.max_batch, cfg.precision, cfg.robust))
+                      cfg.max_batch, cfg.precision, cfg.robust,
+                      cfg.small_n_impl))
         self._cfg_hash = hashlib.sha1(ident.encode()).hexdigest()[:12]
         self._grid_key = (self.grid.dx, self.grid.dy, self.grid.c,
                           self.grid.platform)
@@ -175,6 +190,23 @@ class SolveEngine:
     def _donate(self) -> bool:
         d = self.cfg.donate
         return self.grid.platform == "tpu" if d is None else d
+
+    def _small_route(self, bucket: batching.Bucket) -> bool:
+        """Whether this bucket's executable runs the batched-grid small-N
+        kernels — the same static-shape resolution api.batched('auto')
+        makes at trace time, re-derived here so the stats collector can
+        split small-bucket latency (latency_ms_small) from the rest."""
+        impl = self.cfg.small_n_impl
+        if bucket.op == "inv" or impl == "vmap":
+            return False
+        if impl in ("pallas", "pallas_split"):
+            return True
+        a_shape = (bucket.capacity,) + bucket.a_shape
+        b_shape = ((bucket.capacity,) + bucket.b_shape
+                   if bucket.b_shape is not None else None)
+        return batched_small.default_impl(
+            bucket.op, a_shape, b_shape, bucket.dtype
+        ) == "pallas"
 
     def _get_batched(self, bucket: batching.Bucket, warmup: bool = False):
         key = ("batch", bucket.key, self._grid_key, self._cfg_hash)
@@ -202,7 +234,8 @@ class SolveEngine:
                 dn = (1,)
         elif self._donate():
             dn = (0,)  # inv: the operand batch aliases the inverse batch
-        fn = api.batched(bucket.op, self.cfg.precision)
+        fn = api.batched(bucket.op, self.cfg.precision,
+                         self.cfg.small_n_impl)
         exe = jax.jit(fn, donate_argnums=dn).lower(*specs).compile()
         if self.validate and dn:
             from capital_tpu.lint import program as lint_program
@@ -401,7 +434,7 @@ class SolveEngine:
 
     def _finish(self, ticket: Ticket, op: str, x, raw_info,
                 bucket_key: Optional[tuple], batched: bool,
-                t0: float) -> None:
+                t0: float, small: bool = False) -> None:
         info = self._norm_info(raw_info)
         ok = info is None or info.info == 0
         lat = time.monotonic() - t0
@@ -410,7 +443,8 @@ class SolveEngine:
             error=None, bucket=bucket_key, batched=batched, latency_s=lat,
         )
         self.stats.record_request(op, lat, ok=ok,
-                                  flagged=(info is not None and not ok))
+                                  flagged=(info is not None and not ok),
+                                  small=small)
 
     def _flush(self, bucket: batching.Bucket) -> None:
         q = self._queues.pop(bucket, [])
@@ -422,10 +456,11 @@ class SolveEngine:
         )
         X, info = exe(Ab) if Bb is None else exe(Ab, Bb)
         self.stats.note_batch(occupancy)
+        small = self._small_route(bucket)
         for i, p in enumerate(q):
             xi = batching.crop(bucket.op, X[i], p.a_shape, p.b_shape)
             self._finish(p.ticket, bucket.op, xi, info[i], bucket.key,
-                         True, p.t_enq)
+                         True, p.t_enq, small=small)
 
     def _run_single(self, ticket: Ticket, op: str, A, B, t0: float) -> None:
         a_sds = jax.ShapeDtypeStruct(A.shape, A.dtype)

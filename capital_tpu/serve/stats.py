@@ -34,6 +34,11 @@ class Collector:
         self.queue_depth_max = 0
         self.batches = 0
         self.occupancies: list[float] = []
+        # requests served by the batched-grid small-N kernels — tracked as
+        # their own latency population so `obs serve-report` can gate
+        # small-bucket p99 (--max-p99-ms-small) separately from the large
+        # buckets, whose solve time dominates any mixed percentile.
+        self.latencies_small_s: list[float] = []
 
     # ---- feeding -----------------------------------------------------------
 
@@ -46,11 +51,13 @@ class Collector:
 
     def record_request(
         self, op: str, latency_s: float, ok: bool,
-        flagged: bool = False, failed: bool = False,
+        flagged: bool = False, failed: bool = False, small: bool = False,
     ) -> None:
         self.requests += 1
         self.ops[op] += 1
         self.latencies_s.append(latency_s)
+        if small:
+            self.latencies_small_s.append(latency_s)
         if failed:
             self.failed += 1
         elif flagged:
@@ -73,7 +80,7 @@ class Collector:
             else {"p50": 0.0, "p95": 0.0, "p99": 0.0}
         )
         occ = self.occupancies
-        return {
+        snap = {
             "schema_version": SCHEMA_VERSION,
             "requests": self.requests,
             "ok": self.ok,
@@ -91,6 +98,16 @@ class Collector:
                 "hit_rate": 1.0,
             },
         }
+        # small-N split: present only when small-bucket traffic happened,
+        # so pre-existing records (and engines that never route pallas)
+        # keep the exact schema they always had.
+        if self.latencies_small_s:
+            snap["requests_small"] = len(self.latencies_small_s)
+            snap["latency_ms_small"] = {
+                k: round(v * 1e3, 4)
+                for k, v in percentiles(self.latencies_small_s).items()
+            }
+        return snap
 
     def emit(self, path: str | None, *, grid=None, config=None,
              cache: dict | None = None, **extra) -> dict:

@@ -133,6 +133,12 @@ PHASE_REGISTRY: tuple[str, ...] = (
     # serve::pad wraps bucket padding; serve::solve wraps the per-problem
     # solve kernels inside the batched executables.
     "serve::ingest", "serve::pad", "serve::solve",
+    # batched small-N kernels (ops/batched_small.py).  OP::batched_small
+    # wraps the standalone batched-grid potrf/trsm/potrs kernels;
+    # SV::fused_posv / SV::fused_lstsq wrap the fused factor+solve paths
+    # (factor VMEM-resident between phases — priced as ONE phase because
+    # no inter-phase HBM boundary exists to attribute across).
+    "OP::batched_small", "SV::fused_posv", "SV::fused_lstsq",
 )
 _PHASE_SET: set[str] = set(PHASE_REGISTRY)
 
@@ -434,6 +440,48 @@ def allreduce_cost(grid, m: int, n: int, dtype, axes: str = "all") -> tuple[floa
 def potrf_trtri_flops(n: int) -> float:
     """Local panel factor + triangular inverse: n³/3 + n³/3."""
     return 2.0 * n**3 / 3.0
+
+
+# -- batched small-N kernel pricing (ops/batched_small.py) -----------------
+# These count EXECUTED flops, not textbook useful flops: the batched-grid
+# kernels run full-matrix masked sweeps (rank-1 outer-product Cholesky,
+# one-hot-extraction triangular substitution) because at small n the
+# latency is launch/HBM-bound and dense full-width ops are what Mosaic
+# lowers well.  The cost model must price what the program does, or the
+# obs drift classifier would flag every fused bucket as compiled-extra.
+
+
+def batched_chol_flops(n: int) -> float:
+    """Full-matrix rank-1 sweep Cholesky, per problem: n columns x
+    (extract + scale + rank-1 update + accumulate) ≈ 3 dense (n,n)
+    products of width one plus the n-wide extraction ≈ 6n³."""
+    return 6.0 * n**3
+
+
+def batched_trsm_flops(n: int, k: int) -> float:
+    """One masked substitution sweep, per problem: n columns x (one-hot
+    column extract 2n² + row pick/update 4nk) = 2n³ + 4n²k."""
+    return 2.0 * n**3 + 4.0 * n**2 * k
+
+
+def fused_posv_flops(n: int, k: int) -> float:
+    """Fused factor + two substitution sweeps, per problem (SV::fused_posv):
+    the factor never leaves VMEM, so this is one phase, one price."""
+    return batched_chol_flops(n) + 2.0 * batched_trsm_flops(n, k)
+
+
+def fused_lstsq_flops(m: int, n: int, k: int) -> float:
+    """Fused batched CholeskyQR2 lstsq, per problem (SV::fused_lstsq):
+    gram 2mn² + AᵀB 2mnk, two sweep factors, the R1⁻ᵀ·G·R1⁻¹ correction
+    (n-wide fwd sweep + right-solve ≈ 2 trsm sweeps at k=n), three RHS
+    sweeps and one back-substitution, plus the triangular R2·R1 product."""
+    return (
+        2.0 * m * n * (n + k)
+        + 2.0 * batched_chol_flops(n)
+        + 2.0 * batched_trsm_flops(n, n)
+        + 4.0 * batched_trsm_flops(n, k)
+        + 2.0 * n**3
+    )
 
 
 # --------------------------------------------------------------------------

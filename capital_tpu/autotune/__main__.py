@@ -237,6 +237,11 @@ def main(argv=None) -> None:
                 calls=args.calls, checkpoint=args.resume,
                 ledger=args.ledger, **space,
             )
+            if not rs:
+                # every config's measurement fell below the noise floor
+                # (MeasurementUnresolved): skip the bucket, keep sweeping
+                print(f"bucket n={n}: no resolved measurements")
+                continue
             b = rs[0]
             p99 = (b.extra or {}).get("wall_ms", {}).get("p99")
             print(
@@ -250,6 +255,9 @@ def main(argv=None) -> None:
         res = sweep.tune_cacqr(grid, args.m, args.n if args.n < args.m else 512,
                                dtype, args.out, checkpoint=args.resume,
                                ledger=args.ledger, **space)
+    if not res:
+        print(f"no resolved measurements -> {args.out}/")
+        return
     best = res[0]
     print(f"best: {best.config_id}  {best.seconds * 1e3:.3f} ms  -> {args.out}/")
 

@@ -92,8 +92,12 @@ def eligible(op: str, a_shape: tuple, b_shape: tuple | None, dtype,
              *, interpret: bool | None = None) -> bool:
     """VMEM-envelope gate for ONE problem of a batched-grid kernel: the
     operands plus the f32 working set of one grid step must fit the device
-    budget.  Interpret mode bypasses (no VMEM to exhaust; CPU CI must run
-    the same route the hardware does — qr_fused.fused_plan discipline)."""
+    budget.  Shapes are the BATCHED (batch, m, n) / (batch, n, k) bucket
+    shapes every caller (api.batched 'auto', engine._small_route) holds;
+    only the trailing two dims feed the per-problem footprint — the batch
+    axis lives on the grid, one problem resident at a time.  Interpret mode
+    bypasses (no VMEM to exhaust; CPU CI must run the same route the
+    hardware does — qr_fused.fused_plan discipline)."""
     if interpret is None:
         interpret = _interpret_default()
     if interpret:
@@ -103,7 +107,7 @@ def eligible(op: str, a_shape: tuple, b_shape: tuple | None, dtype,
     n = a_shape[-1]
     k = b_shape[-1] if b_shape is not None else n
     if op == "lstsq":
-        m = a_shape[0]
+        m = a_shape[-2]
         # A + B blocks at dtype; gram/factor/solve working set in f32
         need = m * (n + k) * item + 4 * (4 * n * n + 3 * n * k)
     else:
@@ -111,20 +115,32 @@ def eligible(op: str, a_shape: tuple, b_shape: tuple | None, dtype,
     return need <= limit
 
 
-def default_impl(op: str, a_shape: tuple, b_shape: tuple | None,
-                 dtype) -> str:
-    """Resolve impl='auto' for one bucket: 'pallas' where the batched-grid
-    kernels own the latency (small n, VMEM-eligible, f32-or-narrower),
-    else 'vmap'.  f64 buckets ALWAYS take vmap: the kernels compute in
-    f32 (Mosaic's accumulator width), so routing an f64 request through
-    them would silently downgrade the precision the caller paid for."""
+def dtype_capable(dtype) -> bool:
+    """Whether the batched-grid kernels can serve this dtype without
+    precision loss.  They compute in f32 (Mosaic's accumulator width), so
+    f64 is OUT — unconditionally, even under a forced impl='pallas':
+    routing an f64 request through them would silently downgrade the
+    precision the caller paid for behind f64-labeled outputs."""
+    return jnp.dtype(dtype).itemsize <= 4
+
+
+def default_impl(op: str, a_shape: tuple, b_shape: tuple | None, dtype,
+                 *, interpret: bool | None = None) -> str:
+    """Resolve impl='auto' for one bucket from its BATCHED (batch, m, n)
+    shapes: 'pallas' where the batched-grid kernels own the latency (small
+    n, VMEM-eligible, f32-or-narrower), else 'vmap'.  f64 buckets ALWAYS
+    take vmap (dtype_capable).  `interpret` threads to the VMEM gate —
+    tests force interpret=False to exercise the hardware resolution the
+    CPU rig's interpret bypass would otherwise skip."""
     if op not in ("posv", "lstsq"):
         return "vmap"
-    if jnp.dtype(dtype).itemsize > 4:
+    if not dtype_capable(dtype):
         return "vmap"
     if a_shape[-1] > SMALL_N_MAX:
         return "vmap"
-    return "pallas" if eligible(op, a_shape, b_shape, dtype) else "vmap"
+    return ("pallas"
+            if eligible(op, a_shape, b_shape, dtype, interpret=interpret)
+            else "vmap")
 
 
 # --------------------------------------------------------------------------

@@ -118,20 +118,31 @@ def _batched_pallas(op: str, precision, split: bool):
     """The batched-grid route: whole bucket batch in one (fused) or two
     (split) pallas_calls.  Resolution happened at trace time on static
     shapes, so the returned callable is shape-monomorphic like the vmap
-    one — the engine AOT-compiles it per bucket exactly the same way."""
+    one — the engine AOT-compiles it per bucket exactly the same way.
+
+    f64 buckets ALWAYS fall back to the vmap program, even when the impl
+    was forced: the kernels compute in f32, so honoring impl='pallas' on
+    an f64 bucket would silently downgrade precision behind f64-labeled
+    outputs (batched_small.dtype_capable — the 'f64 always vmap'
+    contract).  The check reads only the static dtype, so the fallback
+    resolves at trace time and the zero-recompile invariant holds."""
     if op == "lstsq":
-        def f(a, b):
+        def kernel(a, b):
             return batched_small.lstsq(a, b, precision=precision)
-        return f
-    if split:
-        def f(a, b):
+    elif split:
+        def kernel(a, b):
             R, info = batched_small.potrf(a, uplo="U", precision=precision)
             return batched_small.potrs(R, b, uplo="U",
                                        precision=precision), info
-        return f
+    else:
+        def kernel(a, b):
+            return batched_small.posv(a, b, uplo="U", precision=precision)
 
     def f(a, b):
-        return batched_small.posv(a, b, uplo="U", precision=precision)
+        if not batched_small.dtype_capable(a.dtype):
+            return _batched_vmap(op, precision)(a, b)
+        return kernel(a, b)
+
     return f
 
 

@@ -199,6 +199,10 @@ class SolveEngine:
         impl = self.cfg.small_n_impl
         if bucket.op == "inv" or impl == "vmap":
             return False
+        if not batched_small.dtype_capable(bucket.dtype):
+            # forced pallas included: api._batched_pallas falls back to the
+            # vmap program for f64, so the executable is NOT small-route
+            return False
         if impl in ("pallas", "pallas_split"):
             return True
         a_shape = (bucket.capacity,) + bucket.a_shape

@@ -86,6 +86,48 @@ class TestDispatch:
         assert batched_small.default_impl(
             "inv", (4, 32, 32), None, jnp.float32) == "vmap"
 
+    def test_eligible_lstsq_rows_not_batch(self):
+        # eligible() receives BATCHED (batch, m, n) shapes: the per-problem
+        # VMEM need must be driven by the row count m = a_shape[-2], not the
+        # bucket capacity.  interpret=False forces the hardware gate the CPU
+        # rig's interpret bypass would skip.
+        tall = 1 << 20  # ~256 MiB of f32 A rows: beyond any VMEM budget
+        assert not batched_small.eligible(
+            "lstsq", (8, tall, 64), (8, tall, 2), jnp.float32,
+            interpret=False)
+        # a large-capacity bucket of short problems stays eligible — the
+        # batch axis rides the grid, one problem resident at a time
+        assert batched_small.eligible(
+            "lstsq", (65536, 64, 64), (65536, 64, 2), jnp.float32,
+            interpret=False)
+
+    def test_default_impl_tall_lstsq_goes_vmap(self):
+        # a tall-m lstsq bucket passes the n <= SMALL_N_MAX check; the VMEM
+        # gate must still route it to vmap under hardware resolution
+        tall = 1 << 20
+        assert batched_small.default_impl(
+            "lstsq", (8, tall, 64), (8, tall, 2), jnp.float32,
+            interpret=False) == "vmap"
+        assert batched_small.default_impl(
+            "lstsq", (65536, 64, 64), (65536, 64, 2), jnp.float32,
+            interpret=False) == "pallas"
+
+    def test_forced_pallas_f64_falls_back_to_vmap(self):
+        # forcing impl='pallas' must not skip the dtype guard: an f64 batch
+        # takes the vmap program (full precision), bit-identical to
+        # impl='vmap', not an f32 kernel pass behind f64-labeled outputs
+        rng = np.random.default_rng(7)
+        A = jnp.asarray(_spd_batch(rng, 2, 16, dtype=np.float64))
+        B = jnp.asarray(rng.standard_normal((2, 16, 2)))
+        assert A.dtype == jnp.float64
+        for impl in ("pallas", "pallas_split"):
+            X, info = api.batched("posv", impl=impl)(A, B)
+            Xr, infor = api.batched("posv", impl="vmap")(A, B)
+            assert X.dtype == jnp.float64
+            np.testing.assert_array_equal(np.asarray(X), np.asarray(Xr))
+            np.testing.assert_array_equal(np.asarray(info),
+                                          np.asarray(infor))
+
     def test_api_batched_rejects_unknown_impl(self):
         with pytest.raises(ValueError, match="impl"):
             api.batched("posv", impl="fortran")

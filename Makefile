@@ -6,7 +6,7 @@
 PY ?= python
 
 .PHONY: all test benchmarking bench-explicit bench-small tune audit lint \
-	robust serve-smoke native clean
+	robust serve-smoke serve-bench native clean
 
 all: test
 
@@ -49,7 +49,7 @@ bench-small:
 
 # model-vs-compiled drift gate on the flagship configs (docs/OBSERVABILITY.md);
 # compile-only — runs in CI without a TPU (exit non-zero on drift)
-audit: serve-smoke lint
+audit: serve-smoke serve-bench lint
 	$(PY) -m capital_tpu.obs audit cholinv --n 4096 --platform cpu
 	$(PY) -m capital_tpu.obs audit cacqr --m 16384 --n 512 --platform cpu
 	$(PY) -m capital_tpu.obs robust-gate --platform cpu
@@ -74,13 +74,38 @@ lint:
 # per-request residual gates inside the smoke itself.  --max-p99-ms-small
 # gates the small-N (batched-grid pallas) request tail; the generous bound
 # absorbs CPU-interpret emulation — what it pins is that the small path ran
-# and reported (the gate fails loudly if no latency_ms_small block exists)
+# and reported (the gate fails loudly if no latency_ms_small block exists).
+# The SECOND smoke is the cold-start proof: same workload, same (now warm)
+# persistent cache dir, --max-compiles 0 — every executable must
+# deserialize from disk, zero fresh XLA compiles (serve/cache.py).
+# --max-queue-wait-ms fails loudly if no record carries the queue-wait /
+# device latency split (serve/stats.py)
 serve-smoke:
 	rm -f serve_smoke.jsonl
+	rm -rf serve_cache
 	$(PY) -m capital_tpu.serve smoke --platform cpu --requests 50 \
+		--persist-dir serve_cache --ledger serve_smoke.jsonl
+	$(PY) -m capital_tpu.serve smoke --platform cpu --requests 50 \
+		--persist-dir serve_cache --max-compiles 0 \
 		--ledger serve_smoke.jsonl
 	$(PY) -m capital_tpu.obs serve-report serve_smoke.jsonl \
-		--min-hit-rate 1.0 --max-p99-ms-small 30000
+		--min-hit-rate 1.0 --max-p99-ms-small 30000 \
+		--max-queue-wait-ms 30000
+
+# continuous-vs-sync A/B (docs/SERVING.md, docs/PERF.md): the fixed-seed
+# closed-loop workload through both schedulers, one request_stats record
+# per mode carrying the loadgen block (QPS, speedup) and the queue-wait /
+# device split, gated on occupancy + zero steady-state recompiles via
+# serve-report.  No speedup gate here: on shared CI hardware the overlap
+# win is real but its magnitude is noisy — the record carries it, PERF.md
+# tracks it
+serve-bench:
+	rm -f serve_bench.jsonl
+	$(PY) -m capital_tpu.serve loadgen --platform cpu --requests 160 \
+		--concurrency 16 --ledger serve_bench.jsonl
+	$(PY) -m capital_tpu.obs serve-report serve_bench.jsonl \
+		--min-hit-rate 1.0 --min-occupancy 0.25 \
+		--max-queue-wait-ms 60000
 
 # breakdown detection / shifted-CholeskyQR recovery / fault-injection suite
 # (docs/ROBUSTNESS.md); CPU rig — tests/conftest.py provides the 8-device
@@ -93,5 +118,5 @@ native:
 
 clean:
 	rm -rf autotune_out .pytest_cache bench_explicit.jsonl serve_smoke.jsonl \
-		lint_report.jsonl bench_small.jsonl
+		lint_report.jsonl bench_small.jsonl serve_bench.jsonl serve_cache
 	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -20,7 +20,7 @@ import jax.numpy as jnp
 
 from capital_tpu.lint.program import ProgramTarget
 
-TARGET_NAMES = ("cholinv", "cacqr", "serve", "batched_small")
+TARGET_NAMES = ("cholinv", "cacqr", "serve", "batched_small", "serve_sched")
 
 
 def _grid():
@@ -138,6 +138,47 @@ def batched_small_targets(
     ]
 
 
+def serve_sched_target(
+    n: int = 64, nrhs: int = 4, capacity: int = 4, dtype=jnp.bfloat16,
+) -> ProgramTarget:
+    """The continuous scheduler's staged-dispatch program (serve/scheduler
+    + executor; docs/SERVING.md): operand normalization under ``SV::stage``
+    — the in-program half of the host->device staging the engine performs
+    at submit — feeding one batched bucket dispatch under ``SV::dispatch``,
+    the boundary the queue-wait/device latency split is measured across.
+
+    bf16 inputs upcast to f32 at the stage boundary (a real convert
+    equation, so the SV::stage tag survives into the jaxpr/HLO name
+    stacks the sanitizer and xla_audit attribute by); n=64 keeps the
+    dispatch on the batched-grid pallas route, so ``flops_audited=False``
+    and no jit-level donation for the same interpret-rig reasons as the
+    batched_small targets."""
+    from capital_tpu.serve import api
+    from capital_tpu.utils import tracing
+
+    dt = jnp.dtype(dtype)
+    a_sds = jax.ShapeDtypeStruct((capacity, n, n), dt)
+    b_sds = jax.ShapeDtypeStruct((capacity, n, nrhs), dt)
+    solve = api.batched("posv")
+
+    def step(a, b):
+        with tracing.scope("SV::stage"):
+            a32 = a.astype(jnp.float32)
+            b32 = b.astype(jnp.float32)
+            # the identity-tail symmetrization pad_operands applies on the
+            # host, in-program form: keeps the staged operand SPD under
+            # the bf16 round-trip
+            a32 = 0.5 * (a32 + jnp.swapaxes(a32, -1, -2))
+        with tracing.scope("SV::dispatch"):
+            X, info = solve(a32, b32)
+        return X.astype(dt), info
+
+    return ProgramTarget(
+        name=f"serve-sched-posv-b{capacity}-n{n}", fn=step,
+        args=(a_sds, b_sds), flops_audited=False,
+    )
+
+
 def flagship_targets(names=None) -> list[ProgramTarget]:
     """The `make lint` program-pass set.  `names` filters to a subset of
     TARGET_NAMES (all three families by default)."""
@@ -152,6 +193,8 @@ def flagship_targets(names=None) -> list[ProgramTarget]:
             out.extend(serve_bucket_targets())
         elif name == "batched_small":
             out.extend(batched_small_targets())
+        elif name == "serve_sched":
+            out.append(serve_sched_target())
         else:
             raise ValueError(
                 f"unknown lint target {name!r}; expected one of {TARGET_NAMES}"

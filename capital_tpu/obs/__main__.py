@@ -207,22 +207,31 @@ def _serve_report(args) -> int:
         return 2
     gates_on = (args.min_hit_rate is not None
                 or args.max_p99_ms is not None
-                or args.max_p99_ms_small is not None)
+                or args.max_p99_ms_small is not None
+                or args.min_occupancy is not None
+                or args.max_queue_wait_ms is not None)
     if not rows:
         print(f"# no request_stats records in {args.ledger} "
               f"({len(recs)} records total)")
         return 1 if gates_on else 0
     failures = []
     small_seen = 0
+    split_seen = 0
     for i, r in enumerate(rows):
         rs = r["request_stats"]
         man = r.get("manifest") or {}
         cache = rs["cache"]
         lat = rs["latency_ms"]
         lat_small = rs.get("latency_ms_small")
+        qwait = rs.get("queue_wait_ms")
         small_note = (
             f" small requests={rs.get('requests_small', 0)} "
             f"p99={lat_small['p99']}" if lat_small else ""
+        )
+        split_note = (
+            f" queue_wait p99={qwait['p99']} "
+            f"device p99={rs['device_ms']['p99']}"
+            if qwait and rs.get("device_ms") else ""
         )
         print(
             f"# [{i}] {man.get('platform', '?')}/{man.get('device', '?')} "
@@ -232,7 +241,7 @@ def _serve_report(args) -> int:
             f"occupancy={rs['batch_occupancy_mean']} "
             f"queue_max={rs['queue_depth_max']} "
             f"cache hits={cache['hits']} misses={cache['misses']} "
-            f"hit_rate={cache['hit_rate']:.3f}" + small_note
+            f"hit_rate={cache['hit_rate']:.3f}" + small_note + split_note
         )
         if (args.min_hit_rate is not None
                 and cache["hit_rate"] < args.min_hit_rate):
@@ -244,6 +253,14 @@ def _serve_report(args) -> int:
             failures.append(
                 f"record #{i}: p99 {lat['p99']}ms > {args.max_p99_ms}ms"
             )
+        if (args.min_occupancy is not None
+                and rs["batch_occupancy_mean"] < args.min_occupancy):
+            failures.append(
+                f"record #{i}: batch occupancy "
+                f"{rs['batch_occupancy_mean']} < {args.min_occupancy} "
+                "(batches flushing too empty — widen max_delay_s or the "
+                "bucket ladders, or raise offered load)"
+            )
         if lat_small is not None:
             small_seen += 1
             if (args.max_p99_ms_small is not None
@@ -252,12 +269,27 @@ def _serve_report(args) -> int:
                     f"record #{i}: small-bucket p99 {lat_small['p99']}ms > "
                     f"{args.max_p99_ms_small}ms"
                 )
+        if qwait is not None:
+            split_seen += 1
+            if (args.max_queue_wait_ms is not None
+                    and qwait["p99"] > args.max_queue_wait_ms):
+                failures.append(
+                    f"record #{i}: queue-wait p99 {qwait['p99']}ms > "
+                    f"{args.max_queue_wait_ms}ms (scheduling delay, not "
+                    "device time — check flush policy / in-flight window)"
+                )
     if args.max_p99_ms_small is not None and not small_seen:
         # same posture as gates-with-no-records: a requested gate that
         # nothing exercised is a silently-dead gate, so it fails loudly.
         failures.append(
             "--max-p99-ms-small requested but no record carries a "
             "latency_ms_small block (no small-bucket traffic served?)"
+        )
+    if args.max_queue_wait_ms is not None and not split_seen:
+        failures.append(
+            "--max-queue-wait-ms requested but no record carries a "
+            "queue_wait_ms block (records predate the latency split, or "
+            "nothing dispatched?)"
         )
     for f in failures:
         print(f"serve-report gate FAIL: {f}", file=sys.stderr)
@@ -393,6 +425,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="fail unless every record's cache hit_rate >= this")
     s.add_argument("--max-p99-ms", type=float, default=None,
                    help="fail when any record's p99 latency exceeds this")
+    s.add_argument("--min-occupancy", type=float, default=None,
+                   help="gate: fail when any record's batch_occupancy_mean "
+                        "falls below this (batches flushing too empty)")
+    s.add_argument("--max-queue-wait-ms", type=float, default=None,
+                   help="gate: fail when any record's queue_wait_ms.p99 "
+                        "exceeds this; fails loudly when no record carries "
+                        "the queue-wait/device latency split")
     s.add_argument("--max-p99-ms-small", type=float, default=None,
                    help="gate the small-N bucket latency split separately: "
                         "fail when any record's latency_ms_small.p99 "

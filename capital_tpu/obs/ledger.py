@@ -274,21 +274,24 @@ def validate_request_stats(block) -> list[str]:
         probs.append(
             f"batch_occupancy_mean must be in [0, 1], got {occ!r}"
         )
-    # small-N split keys (serve small_n_impl pallas routes): optional —
-    # absent on engines that never served a small bucket — but validated
-    # whenever present, same posture as the rest of the block.
-    if "latency_ms_small" in block:
-        lat_s = block["latency_ms_small"]
-        if not isinstance(lat_s, dict):
-            probs.append(
-                f"latency_ms_small must be an object, got {lat_s!r}"
-            )
-        else:
-            for p in _REQ_STATS_PCTS:
-                if not isinstance(lat_s.get(p), (int, float)):
-                    probs.append(
-                        f"latency_ms_small.{p} missing or non-numeric"
-                    )
+    # optional percentile blocks, validated whenever present, same posture
+    # as the rest of the block:
+    #   latency_ms_small — small-N split (serve small_n_impl pallas
+    #     routes); absent on engines that never served a small bucket;
+    #   queue_wait_ms / device_ms — the continuous scheduler's latency
+    #     split (executor timing contract, PR 7); absent on records from
+    #     engines that never dispatched, and on pre-split records, which
+    #     stay valid unchanged.
+    for name in ("latency_ms_small", "queue_wait_ms", "device_ms"):
+        if name not in block:
+            continue
+        lat_o = block[name]
+        if not isinstance(lat_o, dict):
+            probs.append(f"{name} must be an object, got {lat_o!r}")
+            continue
+        for p in _REQ_STATS_PCTS:
+            if not isinstance(lat_o.get(p), (int, float)):
+                probs.append(f"{name}.{p} missing or non-numeric")
     if "requests_small" in block:
         rs = block["requests_small"]
         if not isinstance(rs, int) or isinstance(rs, bool) or rs < 0:

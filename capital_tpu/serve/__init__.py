@@ -9,13 +9,23 @@ a service (docs/SERVING.md).
     eng.pump()            # deadline flushes (or: capacity flushes happen
     resp = eng.drain() or ticket.result()   # inside submit)
 
+The engine is a facade over three pieces (PR 7): `scheduler.py`
+(continuous-batching admission, in-flight window, deadline flushes),
+`cache.py` (AOT executable cache with the optional persistent disk tier —
+``ServeConfig.persist_dir``), and `executor.py` (dispatch, donation, fault
+containment, landing).  `loadgen.py` is the closed-loop A/B + SLO harness.
+
 Smoke workload + gates: ``python -m capital_tpu.serve smoke`` /
-``make serve-smoke``.
+``make serve-smoke``; A/B throughput: ``python -m capital_tpu.serve
+loadgen`` / ``make serve-bench``.
 """
 
+from capital_tpu.serve.cache import ExecutableCache  # noqa: F401
 from capital_tpu.serve.engine import (  # noqa: F401
     Response,
     ServeConfig,
     SolveEngine,
     Ticket,
 )
+from capital_tpu.serve.executor import Executor  # noqa: F401
+from capital_tpu.serve.scheduler import Scheduler  # noqa: F401

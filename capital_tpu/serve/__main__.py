@@ -12,9 +12,18 @@ acceptance properties of docs/SERVING.md:
   residual gets the same 10x allowance the qr drivers use — the gram
   squares the conditioning).
 
-`make serve-smoke` runs this followed by ``obs serve-report
---min-hit-rate 1.0`` over the written ledger, and `make audit` includes
-it in the CI self-checks.
+With ``--persist-dir`` the smoke exercises the persistent AOT tier, and
+``--max-compiles 0`` turns it into the cold-start proof: a SECOND smoke
+pointed at the same (now warm) directory must serve the whole workload
+with zero fresh XLA compiles — every executable deserializes from disk.
+`make serve-smoke` runs exactly that pair, then gates the ledger with
+``obs serve-report``.
+
+``python -m capital_tpu.serve loadgen`` is the closed-loop A/B harness
+(serve/loadgen.py): the same fixed-seed workload through the sync (PR 4
+stop-and-go) and continuous schedulers, one serve:request_stats record
+per mode with the queue-wait/device split and the QPS comparison —
+`make serve-bench` gates those records via ``obs serve-report``.
 """
 
 from __future__ import annotations
@@ -93,6 +102,8 @@ def _smoke(args) -> int:
         # dispatch a TPU deployment gets, and latency_ms_small lands in
         # the record for the --max-p99-ms-small serve-report gate.
         small_n_impl=args.small_n_impl,
+        scheduler=args.scheduler,
+        persist_dir=args.persist_dir,
     )
     eng = SolveEngine(cfg=cfg)
     work = _workload(args.requests, args.seed)
@@ -155,6 +166,13 @@ def _smoke(args) -> int:
             f"steady-state recompile: cache {cache} (expected misses == 0 "
             "after warmup)"
         )
+    if args.max_compiles is not None and cache["compiles"] > args.max_compiles:
+        disk = cache.get("disk", {})
+        failures.append(
+            f"cold-start gate: {cache['compiles']} fresh XLA compiles > "
+            f"--max-compiles {args.max_compiles} (disk tier: {disk}) — the "
+            "persistent cache did not cover the workload"
+        )
     for f in failures:
         print(f"# serve-smoke FAIL: {f}", file=sys.stderr)
     if failures:
@@ -162,8 +180,62 @@ def _smoke(args) -> int:
     print(
         f"# serve-smoke OK: {len(tickets)} requests, hit_rate "
         f"{cache['hit_rate']:.2f} over {cache['hits']} lookups, "
-        f"{n_buckets} bucket shapes"
+        f"{n_buckets} bucket shapes, {cache['compiles']} fresh compiles"
     )
+    return 0
+
+
+def _loadgen(args) -> int:
+    from capital_tpu.serve import loadgen
+    from capital_tpu.serve.engine import ServeConfig
+
+    cfg = ServeConfig(
+        buckets=(16, 32, 64),
+        rows_buckets=(64, 128, 256),
+        nrhs_buckets=(1, 4),
+        max_batch=4,
+        max_delay_s=0.002,
+        small_n_impl=args.small_n_impl,
+        max_inflight=args.max_inflight,
+        persist_dir=args.persist_dir,
+    )
+    wl = loadgen.Workload(
+        requests=args.requests, concurrency=args.concurrency,
+        seed=args.seed, dtype=args.dtype,
+    )
+    results = loadgen.compare(cfg, wl, ledger_path=args.ledger)
+    failures = []
+    for mode in ("sync", "continuous"):
+        res = results.get(mode)
+        if res is None:
+            continue
+        cache = res["cache"]
+        print(
+            f"# serve-loadgen {mode}: {res['requests']} requests in "
+            f"{res['wall_s']:.3f}s = {res['qps']:.1f} qps "
+            f"(concurrency {wl.concurrency}, cache misses "
+            f"{cache['misses']}, compiles {cache['compiles']})"
+        )
+        if res["failed"]:
+            failures.append(f"{mode}: {res['failed']} requests failed")
+        if cache["misses"]:
+            failures.append(
+                f"{mode}: {cache['misses']} steady-state recompiles "
+                "(warmup must cover the workload grid)"
+            )
+    if results.get("speedup") is not None:
+        print(f"# serve-loadgen: continuous/sync speedup "
+              f"{results['speedup']:.2f}x")
+        if args.min_speedup is not None and results["speedup"] < args.min_speedup:
+            failures.append(
+                f"speedup {results['speedup']:.2f}x < --min-speedup "
+                f"{args.min_speedup}"
+            )
+    for f in failures:
+        print(f"# serve-loadgen FAIL: {f}", file=sys.stderr)
+    if failures:
+        return 1
+    print("# serve-loadgen OK")
     return 0
 
 
@@ -181,7 +253,37 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=("auto", "vmap", "pallas", "pallas_split"),
                    help="batched implementation for the bucket executables "
                         "(ServeConfig.small_n_impl; docs/SERVING.md)")
+    s.add_argument("--scheduler", default="continuous",
+                   choices=("continuous", "sync"),
+                   help="admission scheduler (ServeConfig.scheduler)")
+    s.add_argument("--persist-dir", default=None,
+                   help="persistent AOT cache directory (serve/cache.py)")
+    s.add_argument("--max-compiles", type=int, default=None,
+                   help="fail if more than this many fresh XLA compiles "
+                        "happened (0 on a warm --persist-dir = the "
+                        "cold-start proof)")
     s.set_defaults(fn=_smoke)
+    g = sub.add_parser(
+        "loadgen",
+        help="closed-loop sync-vs-continuous A/B harness (serve/loadgen.py)",
+    )
+    g.add_argument("--requests", type=int, default=200)
+    g.add_argument("--concurrency", type=int, default=16)
+    g.add_argument("--seed", type=int, default=0)
+    g.add_argument("--dtype", default="float32")
+    g.add_argument("--ledger", default=None,
+                   help="append one request_stats record per mode here")
+    g.add_argument("--platform", default=None)
+    g.add_argument("--small-n-impl", default="auto",
+                   choices=("auto", "vmap", "pallas", "pallas_split"))
+    g.add_argument("--max-inflight", type=int, default=2,
+                   help="continuous mode's unlanded-batch window")
+    g.add_argument("--persist-dir", default=None,
+                   help="persistent AOT cache directory shared by both modes")
+    g.add_argument("--min-speedup", type=float, default=None,
+                   help="fail if continuous/sync QPS falls below this "
+                        "(leave unset on shared CI hardware)")
+    g.set_defaults(fn=_loadgen)
     return p
 
 

@@ -27,7 +27,16 @@ Two routes per op, chosen by the engine:
     time from the STATIC batch shapes (batched_small.default_impl: pallas
     iff posv/lstsq, n <= SMALL_N_MAX and VMEM-eligible, else vmap) — no
     runtime value feeds the choice, so the engine's zero-recompile
-    invariant is untouched.  inv always takes vmap.
+    invariant is untouched.
+
+    inv rides the posv kernel: the serve contract guarantees an SPD
+    operand (`submit` rejects anything else), so A⁻¹ = posv(A, Iₙ) — the
+    auto resolution treats an inv bucket as a posv with an n-column RHS
+    (batched_small itself keeps its "inv goes vmap" contract; the identity
+    trick is serve policy, decided here).  Beyond the latency win on small
+    buckets, this keeps the program pure HLO, which the persistent
+    executable cache needs on CPU: LAPACK custom calls do not survive
+    serialization across processes (serve/cache.py).
 
   Every batched kernel returns (X, info) with info the per-problem int32
   breakdown status — LAPACK with_info on the vmap path, the in-kernel
@@ -126,6 +135,28 @@ def _batched_pallas(op: str, precision, split: bool):
     outputs (batched_small.dtype_capable — the 'f64 always vmap'
     contract).  The check reads only the static dtype, so the fallback
     resolves at trace time and the zero-recompile invariant holds."""
+    if op == "inv":
+        # SPD inverse as posv against the identity (module docstring);
+        # split runs the factor and the n-column solve as two launches.
+        def kernel(a):
+            eye = jnp.broadcast_to(
+                jnp.eye(a.shape[-1], dtype=a.dtype), a.shape
+            )
+            if split:
+                R, info = batched_small.potrf(
+                    a, uplo="U", precision=precision
+                )
+                return batched_small.potrs(
+                    R, eye, uplo="U", precision=precision
+                ), info
+            return batched_small.posv(a, eye, uplo="U", precision=precision)
+
+        def f_inv(a):
+            if not batched_small.dtype_capable(a.dtype):
+                return _batched_vmap(op, precision)(a)
+            return kernel(a)
+
+        return f_inv
     if op == "lstsq":
         def kernel(a, b):
             return batched_small.lstsq(a, b, precision=precision)
@@ -163,10 +194,24 @@ def batched(op: str, precision: str | None = "highest",
             f"unknown batched impl {impl!r}: expected one of "
             f"{batched_small.IMPLS}"
         )
-    if op == "inv" or impl == "vmap":
+    if impl == "vmap":
         return _batched_vmap(op, precision)
     if impl in ("pallas", "pallas_split"):
         return _batched_pallas(op, precision, split=(impl == "pallas_split"))
+    if op == "inv":
+        # auto for inv: eligibility of the identity-RHS posv (the RHS is
+        # the n-column identity, so the VMEM question is posv's with
+        # b_shape == a_shape) — batched_small's own default_impl keeps
+        # routing op='inv' to vmap; this resolution is serve policy.
+        def auto_inv(a):
+            pick = batched_small.default_impl(
+                "posv", a.shape, a.shape, a.dtype
+            )
+            if pick == "vmap":
+                return _batched_vmap(op, precision)(a)
+            return _batched_pallas(op, precision, split=False)(a)
+
+        return auto_inv
 
     def auto(a, b):
         b_shape = getattr(b, "shape", None)

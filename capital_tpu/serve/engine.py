@@ -1,42 +1,40 @@
-"""SolveEngine: the AOT-cached, shape-bucketed, micro-batching solve service.
+"""SolveEngine: the continuously-batched, AOT-cached, shape-bucketed solve
+service — the facade over serve's three independently-scalable pieces
+(docs/SERVING.md has the full lifecycle):
 
-The serving loop the ROADMAP's "heavy traffic" north star needs, built from
-what PRs 1-3 already provide (docs/SERVING.md has the full lifecycle):
+* **scheduler.py** — admission into in-flight bucket batches, async
+  host→device staging (`jax.device_put` at submit, ahead of dispatch),
+  overlapping dispatch of consecutive buckets with a bounded in-flight
+  window, deadline flushes.  ``ServeConfig.scheduler="sync"`` is the PR 4
+  stop-and-go loop, kept as the measured A/B baseline (serve/loadgen.py).
 
-* **AOT executable cache** — every program the engine runs is compiled once
-  via ``jax.jit(fn).lower(ShapeDtypeStruct...).compile()`` (the aot65536
-  pattern) and cached under an explicit key (op, dtype, shape-bucket,
-  mesh/topology, config-hash).  Hit/miss counters make "steady-state traffic
-  hits zero recompiles" an *assertable* property, not a hope: `warmup()`
-  pre-compiles the bucket ladder without touching the counters, after which
-  a clean run shows misses == 0 / hit_rate == 1.0 (tests/test_serve.py,
-  `make serve-smoke`).
+* **cache.py** — the AOT executable cache: every program the engine runs
+  is compiled once via ``jax.jit(fn).lower(ShapeDtypeStruct...).compile()``
+  under an explicit key (op, dtype, shape-bucket, mesh/topology,
+  config-hash), with hit/miss counters that make "steady-state traffic
+  hits zero recompiles" assertable.  ``ServeConfig.persist_dir`` adds the
+  disk tier: compiled executables are serialized there so replicas and
+  restarts skip warmup entirely (``compiles == 0`` on a warm dir — the
+  cold-start gate of `make serve-smoke`); corrupt or stale entries fall
+  back to compile-and-overwrite, never to the caller.
 
-* **Shape bucketing + micro-batching** — requests pad to bucket ladders
-  (serve/batching.py) and queue per bucket; a batch flushes when it reaches
-  `max_batch` (at submit) or when its oldest request ages past `max_delay_s`
-  (at `pump()`/`drain()`).  Oversize requests bypass batching and run
-  through the real models/ schedules, AOT-cached per exact shape.
+* **executor.py** — dispatch, donation, fault containment, result
+  landing.  Batched dispatch does NOT synchronize; landing stamps each
+  request's queue-wait/device latency split into the stats.
 
-* **Robust routing** — with ServeConfig.robust, each response carries a
-  RobustInfo and a breakdown flags ONE request (`ok=False`) instead of
-  killing the engine; fault injection enters host-side at the
-  ``serve::ingest`` tap on the concrete per-request operand, so a planted
-  fault can never bake into a cached executable (the trace-time-tap hazard
-  faultinject's docstring warns about).
+The engine itself keeps the public surface (`submit`/`pump`/`drain`/
+`solve`/`warmup`/`cache_stats`/`emit_stats`) plus the policies that need
+the whole picture: request validation, the host-side ``serve::ingest``
+fault tap (a planted fault corrupts exactly one request and never bakes
+into a cached executable), bucket resolution, and the config hash.
 
-* **Donation** — batched RHS / operand buffers are donated on TPU only
-  (ServeConfig.donate=None auto): CPU's runtime ignores donation with a
-  warning per executable, and the engine builds those batch arrays itself
-  so donating them is always safe.  Only aliasable buffers are declared:
-  posv donates its RHS batch (solution is shape-for-shape), inv its operand
-  batch; lstsq donates nothing — its (m, nrhs) RHS cannot alias the
-  (n, nrhs) solution, and XLA would silently drop the declaration.
-  ``SolveEngine(validate=True)`` asserts the compiled input_output_alias
-  honors every declared donation at cache-insert time (the lint
-  donation-honored rule; docs/STATIC_ANALYSIS.md).  The single-problem
-  models route never donates: schedules like cholinv's schur_in_place carry
-  their own aliasing contracts on caller buffers.
+Donation (PR 4 contract, unchanged): engine-built batch buffers only,
+TPU-only by default; posv donates its RHS batch, inv its operand batch,
+lstsq nothing — its (m, nrhs) RHS cannot alias the (n, nrhs) solution and
+XLA would silently drop the declaration.  ``SolveEngine(validate=True)``
+asserts the compiled input_output_alias honors every declared donation at
+cache-insert time (fresh compiles only — a disk-loaded executable was
+validated by the process that compiled it).
 """
 
 from __future__ import annotations
@@ -52,8 +50,19 @@ import jax.numpy as jnp
 from capital_tpu.ops import batched_small
 from capital_tpu.parallel.topology import Grid
 from capital_tpu.robust import faultinject
-from capital_tpu.robust.config import RobustConfig, RobustInfo
+from capital_tpu.robust.config import RobustConfig
 from capital_tpu.serve import api, batching, stats
+from capital_tpu.serve.cache import ExecutableCache
+from capital_tpu.serve.executor import (  # noqa: F401  (re-exported API)
+    Executor,
+    Response,
+    Ticket,
+    _Pending,
+)
+from capital_tpu.serve.scheduler import Scheduler
+from capital_tpu.utils import tracing
+
+SCHEDULERS = ("continuous", "sync")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -83,6 +92,17 @@ class ServeConfig:
         LAPACK); 'vmap' / 'pallas' / 'pallas_split' force one route for
         every bucket.  Joins the config hash — two engines differing here
         compile different programs and must never share cache entries.
+    scheduler: 'continuous' (default) overlaps staging/dispatch/landing
+        across consecutive buckets (serve/scheduler.py); 'sync' is the
+        PR 4 stop-and-go flush, kept as the loadgen A/B baseline.  NOT in
+        the config hash: both modes run byte-identical programs, so they
+        share cache entries (and a persistent dir) on purpose.
+    max_inflight: continuous mode's bound on unlanded dispatched batches;
+        the oldest is collected before exceeding it.
+    persist_dir: disk directory for the persistent AOT cache tier
+        (serve/cache.py); None keeps the cache in-memory only.  NOT in
+        the config hash — the hash keys WHAT is compiled, the dir is
+        WHERE it is remembered.
     """
 
     buckets: tuple[int, ...] = (256, 512, 1024)
@@ -95,56 +115,9 @@ class ServeConfig:
     donate: Optional[bool] = None
     oversize: str = "models"
     small_n_impl: str = "auto"
-
-
-@dataclasses.dataclass
-class Response:
-    """One finished request.  `x` is the cropped solution (None only when
-    `ok` is False with `error` set — an ingest fault or a rejected
-    request).  `info` is a RobustInfo under ServeConfig.robust (breakdown
-    != 0 means x is flagged garbage), else None."""
-
-    request_id: int
-    op: str
-    ok: bool
-    x: Optional[jnp.ndarray]
-    info: Optional[RobustInfo]
-    error: Optional[str]
-    bucket: Optional[tuple]
-    batched: bool
-    latency_s: float
-
-
-class Ticket:
-    """Handle returned by submit(); resolves when its batch flushes."""
-
-    __slots__ = ("request_id", "response")
-
-    def __init__(self, request_id: int):
-        self.request_id = request_id
-        self.response: Optional[Response] = None
-
-    @property
-    def done(self) -> bool:
-        return self.response is not None
-
-    def result(self) -> Response:
-        if self.response is None:
-            raise RuntimeError(
-                f"request {self.request_id} not flushed yet — call "
-                "engine.pump() (deadline flush) or engine.drain()"
-            )
-        return self.response
-
-
-@dataclasses.dataclass
-class _Pending:
-    ticket: Ticket
-    pa: jnp.ndarray
-    pb: Optional[jnp.ndarray]
-    a_shape: tuple[int, ...]
-    b_shape: Optional[tuple[int, ...]]
-    t_enq: float
+    scheduler: str = "continuous"
+    max_inflight: int = 2
+    persist_dir: Optional[str] = None
 
 
 class SolveEngine:
@@ -161,6 +134,14 @@ class SolveEngine:
                 f"unknown small_n_impl {cfg.small_n_impl!r}: expected one "
                 f"of {batched_small.IMPLS}"
             )
+        if cfg.scheduler not in SCHEDULERS:
+            raise ValueError(
+                f"unknown scheduler {cfg.scheduler!r}: expected one of "
+                f"{SCHEDULERS}"
+            )
+        if cfg.max_inflight < 1:
+            raise ValueError(f"max_inflight must be >= 1, got "
+                             f"{cfg.max_inflight}")
         self.grid = grid or Grid.square(c=1, devices=jax.devices()[:1])
         self.cfg = cfg
         # validate: run the lint donation-honored rule on every executable at
@@ -169,15 +150,19 @@ class SolveEngine:
         # the batch buffer double-resident for the cache entry's lifetime.
         self.validate = validate
         self.stats = stats.Collector()
-        self._exe: dict[tuple, object] = {}
-        self._queues: dict[batching.Bucket, list[_Pending]] = {}
-        self._hits = 0
-        self._misses = 0
-        self._warmup_compiles = 0
+        self.cache = ExecutableCache(cfg.persist_dir)
+        self.executor = Executor(cfg, self.grid, self.stats)
+        self.scheduler = Scheduler(cfg, self.executor, self._resolve_bucket)
         self._next_id = 0
+        # the device batched executables run on — staging target.  The
+        # bucket programs are single-device (jit, no sharding); oversize
+        # requests run the models/ schedules on the full grid.
+        self._stage_device = self.grid.mesh.devices.ravel()[0]
         # config-hash: everything that changes the compiled programs or the
         # padding geometry — two engines differing here must never share
-        # cache entries, and the key makes that structural.
+        # cache entries, and the key makes that structural.  scheduler /
+        # max_inflight / persist_dir are deliberately absent: they change
+        # when and where programs run, never what was compiled.
         ident = repr((cfg.buckets, cfg.rows_buckets, cfg.nrhs_buckets,
                       cfg.max_batch, cfg.precision, cfg.robust,
                       cfg.small_n_impl))
@@ -187,17 +172,13 @@ class SolveEngine:
 
     # ---- cache -------------------------------------------------------------
 
-    def _donate(self) -> bool:
-        d = self.cfg.donate
-        return self.grid.platform == "tpu" if d is None else d
-
     def _small_route(self, bucket: batching.Bucket) -> bool:
         """Whether this bucket's executable runs the batched-grid small-N
         kernels — the same static-shape resolution api.batched('auto')
         makes at trace time, re-derived here so the stats collector can
         split small-bucket latency (latency_ms_small) from the rest."""
         impl = self.cfg.small_n_impl
-        if bucket.op == "inv" or impl == "vmap":
+        if impl == "vmap":
             return False
         if not batched_small.dtype_capable(bucket.dtype):
             # forced pallas included: api._batched_pallas falls back to the
@@ -206,95 +187,82 @@ class SolveEngine:
         if impl in ("pallas", "pallas_split"):
             return True
         a_shape = (bucket.capacity,) + bucket.a_shape
+        if bucket.op == "inv":
+            # inv rides the posv kernel with an identity RHS (api.batched):
+            # eligibility is posv's with b_shape == a_shape
+            return batched_small.default_impl(
+                "posv", a_shape, a_shape, bucket.dtype
+            ) == "pallas"
         b_shape = ((bucket.capacity,) + bucket.b_shape
                    if bucket.b_shape is not None else None)
         return batched_small.default_impl(
             bucket.op, a_shape, b_shape, bucket.dtype
         ) == "pallas"
 
+    def _resolve_bucket(self, bucket: batching.Bucket) -> tuple:
+        """The scheduler's get_exe callback: (executable, small_route)."""
+        return self._get_batched(bucket), self._small_route(bucket)
+
     def _get_batched(self, bucket: batching.Bucket, warmup: bool = False):
         key = ("batch", bucket.key, self._grid_key, self._cfg_hash)
-        exe = self._exe.get(key)
-        if exe is not None:
-            if not warmup:
-                self._hits += 1
-            return exe
-        if warmup:
-            self._warmup_compiles += 1
-        else:
-            self._misses += 1
-        dt = jnp.dtype(bucket.dtype)
-        specs = [jax.ShapeDtypeStruct((bucket.capacity,) + bucket.a_shape, dt)]
-        dn: tuple[int, ...] = ()
-        if bucket.b_shape is not None:
-            specs.append(
-                jax.ShapeDtypeStruct((bucket.capacity,) + bucket.b_shape, dt)
-            )
-            # Only posv's solution aliases its RHS shape-for-shape.  lstsq's
-            # (m, nrhs) RHS can never alias the (n, nrhs) solution, so XLA
-            # would silently drop that donation (lint rule donation-honored)
-            # and the batch would sit double-resident in HBM.
-            if self._donate() and bucket.op == "posv":
-                dn = (1,)
-        elif self._donate():
-            dn = (0,)  # inv: the operand batch aliases the inverse batch
-        fn = api.batched(bucket.op, self.cfg.precision,
-                         self.cfg.small_n_impl)
-        exe = jax.jit(fn, donate_argnums=dn).lower(*specs).compile()
-        if self.validate and dn:
-            from capital_tpu.lint import program as lint_program
+        dn = self.executor.donate_argnums(bucket)
 
-            probs = lint_program.check_donation(
-                exe, dn, target=f"serve:{bucket.key}",
-            )
-            if probs:
-                raise AssertionError(
-                    "donation dropped at cache insert: "
-                    + "; ".join(f.message for f in probs)
+        def build():
+            dt = jnp.dtype(bucket.dtype)
+            specs = [jax.ShapeDtypeStruct(
+                (bucket.capacity,) + bucket.a_shape, dt)]
+            if bucket.b_shape is not None:
+                specs.append(jax.ShapeDtypeStruct(
+                    (bucket.capacity,) + bucket.b_shape, dt))
+            fn = api.batched(bucket.op, self.cfg.precision,
+                             self.cfg.small_n_impl)
+            exe = jax.jit(fn, donate_argnums=dn).lower(*specs).compile()
+            if self.validate and dn:
+                from capital_tpu.lint import program as lint_program
+
+                probs = lint_program.check_donation(
+                    exe, dn, target=f"serve:{bucket.key}",
                 )
-        self._exe[key] = exe
-        return exe
+                if probs:
+                    raise AssertionError(
+                        "donation dropped at cache insert: "
+                        + "; ".join(f.message for f in probs)
+                    )
+            return exe
+
+        return self.cache.get(key, build, warmup=warmup)
 
     def _get_single(self, op: str, a_sds, b_sds, warmup: bool = False):
         key = ("single", op, str(a_sds.dtype), a_sds.shape,
                b_sds.shape if b_sds is not None else None,
                self._grid_key, self._cfg_hash)
-        exe = self._exe.get(key)
-        if exe is not None:
-            if not warmup:
-                self._hits += 1
-            return exe
-        if warmup:
-            self._warmup_compiles += 1
-        else:
-            self._misses += 1
-        fn = api.single(op, self.grid, self.cfg.precision, self.cfg.robust)
-        specs = (a_sds,) if b_sds is None else (a_sds, b_sds)
-        exe = jax.jit(fn).lower(*specs).compile()
-        self._exe[key] = exe
-        return exe
+
+        def build():
+            fn = api.single(op, self.grid, self.cfg.precision,
+                            self.cfg.robust)
+            specs = (a_sds,) if b_sds is None else (a_sds, b_sds)
+            return jax.jit(fn).lower(*specs).compile()
+
+        return self.cache.get(key, build, warmup=warmup)
 
     def cache_stats(self) -> dict:
-        """Hit/miss counters over request-driven executable lookups.
-        warmup() compiles count separately — hit_rate measures steady-state
-        traffic, and the acceptance gate is hit_rate == 1.0 after warmup."""
-        lookups = self._hits + self._misses
-        return {
-            "hits": self._hits,
-            "misses": self._misses,
-            "warmup_compiles": self._warmup_compiles,
-            "entries": len(self._exe),
-            "hit_rate": (self._hits / lookups) if lookups else 1.0,
-        }
+        """Hit/miss counters over request-driven executable lookups plus
+        compile and persistent-tier counters (serve/cache.py).  warmup()
+        compiles count separately — hit_rate measures steady-state
+        traffic, and the acceptance gate is hit_rate == 1.0 after warmup;
+        ``compiles`` is the cold-start gate (0 on a warm persistent
+        dir)."""
+        return self.cache.stats()
 
     def warmup(self, specs) -> int:
-        """Pre-compile executables for example request shapes.  `specs` is
-        an iterable of (op, a_shape, b_shape, dtype) — b_shape None for
-        inv.  Shapes resolve through the SAME bucket ladder as submit(),
-        so warming one representative per bucket covers every shape that
-        maps there; oversize shapes warm their exact-shape single route.
-        Returns the number of fresh compiles."""
-        before = self._warmup_compiles
+        """Pre-compile (or load from the persistent tier) executables for
+        example request shapes.  `specs` is an iterable of (op, a_shape,
+        b_shape, dtype) — b_shape None for inv.  Shapes resolve through
+        the SAME bucket ladder as submit(), so warming one representative
+        per bucket covers every shape that maps there; oversize shapes
+        warm their exact-shape single route.  Returns the number of fresh
+        compiles (0 when every entry loaded from a warm persist_dir)."""
+        before = self.cache.warmup_compiles
         for op, a_shape, b_shape, dtype in specs:
             dt = jnp.dtype(dtype)
             bucket = batching.bucket_for(
@@ -308,18 +276,20 @@ class SolveEngine:
                 b_sds = (jax.ShapeDtypeStruct(tuple(b_shape), dt)
                          if b_shape else None)
                 self._get_single(op, a_sds, b_sds, warmup=True)
-        return self._warmup_compiles - before
+        return self.cache.warmup_compiles - before
 
     # ---- request path ------------------------------------------------------
 
     def submit(self, op: str, A, B=None) -> Ticket:
         """Enqueue one solve request; returns a Ticket that resolves when
-        its batch flushes (possibly within this call: capacity flush, or
-        immediately for oversize requests)."""
-        t0 = time.monotonic()
+        its batch lands.  A capacity-full bucket DISPATCHES inside this
+        call; under the continuous scheduler the dispatch is issued
+        without waiting (the ticket is `done`, and `result()`/`pump()`/
+        `drain()` land it)."""
+        t_enq = time.monotonic()
         tid = self._next_id
         self._next_id += 1
-        ticket = Ticket(tid)
+        ticket = Ticket(tid, t_enq)
         A = jnp.asarray(A)
         B = jnp.asarray(B) if B is not None else None
         if op not in batching.OPS:
@@ -343,7 +313,7 @@ class SolveEngine:
             # one request and leaves the executable cache clean.
             A = faultinject.tap(A, point="serve::ingest")
         except faultinject.FaultInjected as e:
-            self._fail(ticket, op, str(e), t0)
+            self.executor.fail(ticket, op, str(e), t_enq)
             return ticket
         bucket = batching.bucket_for(
             op, A.shape, B.shape if B is not None else None,
@@ -351,47 +321,44 @@ class SolveEngine:
         )
         if bucket is None:
             if self.cfg.oversize == "reject":
-                self._fail(
+                self.executor.fail(
                     ticket, op,
                     f"no bucket for {op} {A.shape} and oversize='reject'",
-                    t0,
+                    t_enq,
                 )
             else:
-                self._run_single(ticket, op, A, B, t0)
+                self._run_single(ticket, op, A, B, t_enq)
             return ticket
         pa, pb = batching.pad_operands(op, A, B, bucket)
-        q = self._queues.setdefault(bucket, [])
-        q.append(_Pending(
+        if self.cfg.scheduler == "continuous":
+            # async host->device staging AHEAD of dispatch: the transfer
+            # overlaps whatever batch is currently executing, so by flush
+            # time the operands are already device-resident (on-device
+            # no-op when eager padding placed them there)
+            with tracing.scope("SV::stage"):
+                pa = jax.device_put(pa, self._stage_device)
+                if pb is not None:
+                    pb = jax.device_put(pb, self._stage_device)
+        self.scheduler.admit(bucket, _Pending(
             ticket, pa, pb, tuple(A.shape),
-            tuple(B.shape) if B is not None else None, t0,
+            tuple(B.shape) if B is not None else None, t_enq,
         ))
         self.stats.note_queue_depth(self.queue_depth())
-        if len(q) >= bucket.capacity:
-            self._flush(bucket)
         return ticket
 
     def pump(self, now: Optional[float] = None) -> int:
-        """Deadline flush: run every bucket whose oldest request has aged
-        past max_delay_s.  Call from the dispatch loop between submits;
-        returns the number of batches flushed."""
+        """Deadline flush + opportunistic landing: dispatch every bucket
+        whose oldest request has aged past max_delay_s, and land every
+        in-flight batch whose results are ready.  Call from the dispatch
+        loop between submits; returns the number of batches flushed."""
         now = time.monotonic() if now is None else now
-        flushed = 0
-        for bucket in list(self._queues):
-            q = self._queues.get(bucket)
-            if q and now - q[0].t_enq >= self.cfg.max_delay_s:
-                self._flush(bucket)
-                flushed += 1
-        return flushed
+        return self.scheduler.pump(now)
 
     def drain(self) -> int:
-        """Flush every non-empty queue regardless of age (shutdown / test
-        barrier).  Returns the number of batches flushed."""
-        flushed = 0
-        for bucket in list(self._queues):
-            if self._queues.get(bucket):
-                self._flush(bucket)
-                flushed += 1
-        return flushed
+        """Flush every non-empty queue regardless of age and land every
+        in-flight batch (shutdown / test barrier).  Returns the number of
+        batches flushed."""
+        return self.scheduler.drain()
 
     def solve(self, op: str, A, B=None) -> Response:
         """Convenience synchronous path: submit + drain + result."""
@@ -401,7 +368,7 @@ class SolveEngine:
         return ticket.result()
 
     def queue_depth(self) -> int:
-        return sum(len(q) for q in self._queues.values())
+        return self.scheduler.queue_depth()
 
     def emit_stats(self, path: Optional[str] = None, **extra) -> dict:
         """Snapshot telemetry + cache counters into one serve:request_stats
@@ -413,63 +380,10 @@ class SolveEngine:
 
     # ---- internals ---------------------------------------------------------
 
-    def _fail(self, ticket: Ticket, op: str, error: str, t0: float) -> None:
-        lat = time.monotonic() - t0
-        ticket.response = Response(
-            request_id=ticket.request_id, op=op, ok=False, x=None,
-            info=None, error=error, bucket=None, batched=False,
-            latency_s=lat,
-        )
-        self.stats.record_request(op, lat, ok=False, failed=True)
-
-    def _norm_info(self, raw) -> Optional[RobustInfo]:
-        if self.cfg.robust is None:
-            return None
-        if isinstance(raw, RobustInfo):
-            return RobustInfo(
-                info=int(raw.info), breakdown=int(raw.breakdown),
-                shifted=int(raw.shifted), sigma=float(raw.sigma),
-                escalated=int(raw.escalated), ortho=float(raw.ortho),
-            )
-        i = int(raw)
-        # detect-only sites surface the potrf convention; no recovery ran
-        return RobustInfo(info=i, breakdown=int(i != 0), shifted=0,
-                          sigma=0.0, escalated=0, ortho=-1.0)
-
-    def _finish(self, ticket: Ticket, op: str, x, raw_info,
-                bucket_key: Optional[tuple], batched: bool,
-                t0: float, small: bool = False) -> None:
-        info = self._norm_info(raw_info)
-        ok = info is None or info.info == 0
-        lat = time.monotonic() - t0
-        ticket.response = Response(
-            request_id=ticket.request_id, op=op, ok=ok, x=x, info=info,
-            error=None, bucket=bucket_key, batched=batched, latency_s=lat,
-        )
-        self.stats.record_request(op, lat, ok=ok,
-                                  flagged=(info is not None and not ok),
-                                  small=small)
-
-    def _flush(self, bucket: batching.Bucket) -> None:
-        q = self._queues.pop(bucket, [])
-        if not q:
-            return
-        exe = self._get_batched(bucket)
-        Ab, Bb, occupancy = batching.assemble(
-            [p.pa for p in q], [p.pb for p in q], bucket,
-        )
-        X, info = exe(Ab) if Bb is None else exe(Ab, Bb)
-        self.stats.note_batch(occupancy)
-        small = self._small_route(bucket)
-        for i, p in enumerate(q):
-            xi = batching.crop(bucket.op, X[i], p.a_shape, p.b_shape)
-            self._finish(p.ticket, bucket.op, xi, info[i], bucket.key,
-                         True, p.t_enq, small=small)
-
-    def _run_single(self, ticket: Ticket, op: str, A, B, t0: float) -> None:
+    def _run_single(self, ticket: Ticket, op: str, A, B,
+                    t_enq: float) -> None:
         a_sds = jax.ShapeDtypeStruct(A.shape, A.dtype)
         b_sds = (jax.ShapeDtypeStruct(B.shape, B.dtype)
                  if B is not None else None)
         exe = self._get_single(op, a_sds, b_sds)
-        x, raw = exe(A) if B is None else exe(A, B)
-        self._finish(ticket, op, x, raw, None, False, t0)
+        self.executor.run_single(ticket, op, A, B, exe, t_enq)

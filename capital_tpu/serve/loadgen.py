@@ -1,0 +1,188 @@
+"""Closed-loop load generator + SLO harness for the solve engine.
+
+Closed-loop means each simulated client holds exactly one request in
+flight: it submits, waits for the *landed* result, then submits the next.
+Offered load is therefore `concurrency` outstanding requests, not a fixed
+arrival rate — the honest way to measure a scheduler, because an open-loop
+generator keeps offering work while the system backs up and turns a
+throughput problem into an unbounded-queue artifact.
+
+The harness drives one engine per scheduler mode over the SAME fixed-seed
+workload and emits one `serve:request_stats` ledger record per mode, each
+carrying a `loadgen` block (mode, concurrency, sustained QPS, wall time).
+The continuous record also carries the sync baseline's QPS and the
+speedup, so `obs serve-report` can gate the A/B result from the ledger
+alone (`make serve-bench`):
+
+* **throughput** — continuous vs sync QPS at equal occupancy (same
+  workload, same ladder, same capacity ⇒ same batch shapes);
+* **SLO split** — queue-wait vs on-device percentiles per mode: the
+  overlap win shows up as queue-wait shrinking while device stays put;
+* **zero steady-state recompiles** — each record's cache block
+  (`misses == 0`, `hit_rate == 1.0` after warmup).
+
+Everything here is host-side policy around `SolveEngine`'s public surface
+(submit/pump/drain) — no jax in this module beyond what the engine does.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional
+
+import numpy as np
+
+from capital_tpu.serve.engine import ServeConfig, SolveEngine
+
+
+@dataclasses.dataclass(frozen=True)
+class Workload:
+    """A reproducible request mix.  Shapes are drawn per-request from the
+    ladders below with numpy's seeded Generator, so two runs (or two
+    scheduler modes) see byte-identical operands in identical order.
+    lstsq rows are 4*n — pick `ns` so that lands on the engine's
+    rows_buckets ladder or the oversize path will dominate the measure."""
+
+    requests: int = 200
+    concurrency: int = 16
+    seed: int = 0
+    ops: tuple[str, ...] = ("posv", "lstsq")
+    ns: tuple[int, ...] = (16, 32, 64)
+    nrhs: tuple[int, ...] = (1, 4)
+    dtype: str = "float32"
+
+
+def build_requests(wl: Workload) -> list[tuple]:
+    """Materialize the workload: a list of (op, A, B) with well-conditioned
+    operands (SPD via G@G.T + n*I; tall G for lstsq)."""
+    rng = np.random.default_rng(wl.seed)
+    dt = np.dtype(wl.dtype)
+    out = []
+    for _ in range(wl.requests):
+        op = wl.ops[int(rng.integers(len(wl.ops)))]
+        n = int(wl.ns[int(rng.integers(len(wl.ns)))])
+        k = int(wl.nrhs[int(rng.integers(len(wl.nrhs)))])
+        if op == "lstsq":
+            A = rng.standard_normal((4 * n, n)).astype(dt)
+            B = rng.standard_normal((4 * n, k)).astype(dt)
+        else:
+            G = rng.standard_normal((n, n)).astype(dt)
+            A = (G @ G.T + n * np.eye(n, dtype=dt)).astype(dt)
+            B = (rng.standard_normal((n, k)).astype(dt)
+                 if op == "posv" else None)
+        out.append((op, A, B))
+    return out
+
+
+def warmup_specs(wl: Workload) -> list[tuple]:
+    """One warmup spec per (op, n, nrhs) cell the workload can draw — after
+    warmup(specs) every request hits the executable cache."""
+    specs = []
+    for op in wl.ops:
+        for n in wl.ns:
+            for k in wl.nrhs:
+                if op == "lstsq":
+                    specs.append((op, (4 * n, n), (4 * n, k), wl.dtype))
+                elif op == "posv":
+                    specs.append((op, (n, n), (n, k), wl.dtype))
+                else:
+                    specs.append((op, (n, n), None, wl.dtype))
+    return specs
+
+
+def run_closed_loop(eng: SolveEngine, requests: list[tuple],
+                    concurrency: int) -> dict:
+    """Drive one engine to completion over `requests` with at most
+    `concurrency` clients outstanding.  A client's slot frees when its
+    Response LANDS (not merely when its batch dispatches) — that is the
+    closed loop.  Returns wall-clock QPS and completion counts."""
+    todo = list(requests)
+    todo.reverse()  # pop() from the tail preserves workload order
+    outstanding: list = []
+    completed = ok = failed = 0
+    t_start = time.monotonic()
+    while todo or outstanding:
+        progressed = False
+        while todo and len(outstanding) < concurrency:
+            op, A, B = todo.pop()
+            outstanding.append(eng.submit(op, A, B))
+            progressed = True
+        eng.pump()
+        still = []
+        for t in outstanding:
+            if t.response is not None:
+                completed += 1
+                ok += 1 if t.response.ok else 0
+                failed += 0 if t.response.ok else 1
+                progressed = True
+            else:
+                still.append(t)
+        outstanding = still
+        if progressed:
+            continue
+        # nothing moved this iteration: force the oldest dispatched batch
+        # to land, or (if everything is queued behind the flush deadline)
+        # wait it out / drain the tail.
+        dispatched = [t for t in outstanding if t.done]
+        if dispatched:
+            dispatched[0].result()
+        elif eng.queue_depth() and todo:
+            time.sleep(min(eng.cfg.max_delay_s, 1e-3))
+        else:
+            eng.drain()
+    wall = time.monotonic() - t_start
+    return {
+        "requests": completed,
+        "ok": ok,
+        "failed": failed,
+        "wall_s": round(wall, 6),
+        "qps": round(completed / wall, 3) if wall > 0 else 0.0,
+    }
+
+
+def _mk_engine(cfg: ServeConfig, scheduler: str, grid=None) -> SolveEngine:
+    return SolveEngine(grid, dataclasses.replace(cfg, scheduler=scheduler))
+
+
+def compare(cfg: ServeConfig, wl: Workload = Workload(), *, grid=None,
+            ledger_path: Optional[str] = None,
+            modes: tuple[str, ...] = ("sync", "continuous")) -> dict:
+    """The A/B harness: run the same workload through each scheduler mode
+    (fresh engine each, shared ServeConfig otherwise — including
+    persist_dir, which both may share safely), emit one ledger record per
+    mode, and return {mode: results, 'speedup': continuous_qps/sync_qps}.
+
+    The sync mode runs first so a cold persist_dir is warm for the
+    continuous run in the same way a restart would see it; with warmup()
+    covering the whole workload grid, both modes serve at misses == 0
+    either way."""
+    requests = build_requests(wl)
+    specs = warmup_specs(wl)
+    results: dict = {}
+    records: dict = {}
+    for mode in modes:
+        eng = _mk_engine(cfg, mode, grid)
+        eng.warmup(specs)
+        results[mode] = run_closed_loop(eng, requests, wl.concurrency)
+        results[mode]["cache"] = eng.cache_stats()
+        records[mode] = (eng, results[mode])
+    speedup = None
+    if "sync" in results and "continuous" in results:
+        base = results["sync"]["qps"]
+        speedup = (round(results["continuous"]["qps"] / base, 4)
+                   if base else None)
+        results["speedup"] = speedup
+    for mode, (eng, res) in records.items():
+        block = {
+            "mode": mode,
+            "concurrency": wl.concurrency,
+            "seed": wl.seed,
+            "qps": res["qps"],
+            "wall_s": res["wall_s"],
+        }
+        if mode == "continuous" and speedup is not None:
+            block["baseline_qps"] = results["sync"]["qps"]
+            block["speedup"] = speedup
+        res["record"] = eng.emit_stats(ledger_path, loadgen=block)
+    return results

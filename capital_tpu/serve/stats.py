@@ -39,6 +39,14 @@ class Collector:
         # small-bucket p99 (--max-p99-ms-small) separately from the large
         # buckets, whose solve time dominates any mixed percentile.
         self.latencies_small_s: list[float] = []
+        # the two halves of each dispatched request's latency (executor
+        # timing contract): queue-wait is scheduling policy, device is
+        # compute + transfer.  Separate populations (not per-request pairs)
+        # because the report gates each tail independently
+        # (--max-queue-wait-ms); requests that never dispatched (ingest
+        # faults, rejects) contribute to neither.
+        self.queue_waits_s: list[float] = []
+        self.devices_s: list[float] = []
 
     # ---- feeding -----------------------------------------------------------
 
@@ -52,12 +60,17 @@ class Collector:
     def record_request(
         self, op: str, latency_s: float, ok: bool,
         flagged: bool = False, failed: bool = False, small: bool = False,
+        queue_wait_s: float | None = None, device_s: float | None = None,
     ) -> None:
         self.requests += 1
         self.ops[op] += 1
         self.latencies_s.append(latency_s)
         if small:
             self.latencies_small_s.append(latency_s)
+        if queue_wait_s is not None:
+            self.queue_waits_s.append(queue_wait_s)
+        if device_s is not None:
+            self.devices_s.append(device_s)
         if failed:
             self.failed += 1
         elif flagged:
@@ -106,6 +119,21 @@ class Collector:
             snap["latency_ms_small"] = {
                 k: round(v * 1e3, 4)
                 for k, v in percentiles(self.latencies_small_s).items()
+            }
+        # queue-wait / on-device split: present only when dispatched traffic
+        # happened (same optional-block discipline as latency_ms_small, so
+        # records from older engines stay valid and the report's
+        # --max-queue-wait-ms gate can fail loudly when the split is absent
+        # rather than silently passing on zeros).
+        if self.queue_waits_s:
+            snap["queue_wait_ms"] = {
+                k: round(v * 1e3, 4)
+                for k, v in percentiles(self.queue_waits_s).items()
+            }
+        if self.devices_s:
+            snap["device_ms"] = {
+                k: round(v * 1e3, 4)
+                for k, v in percentiles(self.devices_s).items()
             }
         return snap
 

@@ -139,6 +139,13 @@ PHASE_REGISTRY: tuple[str, ...] = (
     # (factor VMEM-resident between phases — priced as ONE phase because
     # no inter-phase HBM boundary exists to attribute across).
     "OP::batched_small", "SV::fused_posv", "SV::fused_lstsq",
+    # continuous-batching scheduler (serve/scheduler.py, docs/SERVING.md).
+    # SV::stage wraps host->device staging of padded operands ahead of
+    # dispatch (jax.device_put at submit time, plus the in-program operand
+    # normalization of the staged-dispatch lint target); SV::dispatch wraps
+    # the batched bucket dispatch itself — the boundary the queue_wait /
+    # device latency split in serve/stats.py measures across.
+    "SV::stage", "SV::dispatch",
 )
 _PHASE_SET: set[str] = set(PHASE_REGISTRY)
 

@@ -274,7 +274,7 @@ class TestEngineCache:
         assert n == 2  # the duplicate bucket warms once
         c = eng.cache_stats()
         assert c == {"hits": 0, "misses": 0, "warmup_compiles": 2,
-                     "entries": 2, "hit_rate": 1.0}
+                     "compiles": 2, "entries": 2, "hit_rate": 1.0}
 
     def test_distinct_configs_never_share_entries(self):
         e1 = SolveEngine(cfg=CFG)

@@ -48,11 +48,24 @@ bench-small:
 		--small-impl pallas --validate --ledger bench_small.jsonl
 
 # model-vs-compiled drift gate on the flagship configs (docs/OBSERVABILITY.md);
-# compile-only — runs in CI without a TPU (exit non-zero on drift)
+# compile-only — runs in CI without a TPU (exit non-zero on drift).  The
+# bench.trace step is the phase-attribution gate: it decomposes a real
+# (small-shape) cholinv wall into per-phase seconds, fails if the
+# unattributed bubble fraction blows the budget OR if nothing could be
+# attributed at all (dead-gate protection), and re-gates the ledger record
+# through obs trace-report — the same double-entry discipline as lint.
+# The generous 0.995 bound absorbs CPU-interpret emulation; what it pins
+# is that attribution works end to end.
 audit: serve-smoke serve-bench lint
 	$(PY) -m capital_tpu.obs audit cholinv --n 4096 --platform cpu
 	$(PY) -m capital_tpu.obs audit cacqr --m 16384 --n 512 --platform cpu
 	$(PY) -m capital_tpu.obs robust-gate --platform cpu
+	rm -f bench_trace.jsonl
+	$(PY) -m capital_tpu.bench.trace cholinv --n 768 --bc 256 \
+		--dtype float32 --iters 2 --platform cpu \
+		--max-bubble-frac 0.995 --ledger bench_trace.jsonl
+	$(PY) -m capital_tpu.obs trace-report bench_trace.jsonl \
+		--max-bubble-frac 0.995
 
 # static analysis gate (docs/STATIC_ANALYSIS.md): the program sanitizer over
 # the flagship cholinv/cacqr/serve-bucket entry points (phase coverage,
@@ -118,5 +131,6 @@ native:
 
 clean:
 	rm -rf autotune_out .pytest_cache bench_explicit.jsonl serve_smoke.jsonl \
-		lint_report.jsonl bench_small.jsonl serve_bench.jsonl serve_cache
+		lint_report.jsonl bench_small.jsonl serve_bench.jsonl serve_cache \
+		bench_trace.jsonl
 	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -28,6 +28,11 @@ def main(argv=None) -> None:
         help="cholinv: BaseCasePolicy names (e.g. REPLICATE_COMM_COMP)",
     )
     p.add_argument(
+        "--tail-depths", type=int, nargs="+", default=None,
+        help="cholinv: tail_fuse_depth values to sweep (fused recursion "
+        "tail, CholinvConfig.tail_fuse_depth; 0 = unfused)",
+    )
+    p.add_argument(
         "--top-k", type=int, default=0,
         help="cholinv: measure only the native planner's top-k model candidates",
     )
@@ -167,6 +172,8 @@ def main(argv=None) -> None:
             from capital_tpu.utils.config import BaseCasePolicy
 
             space["policies"] = tuple(BaseCasePolicy[p] for p in args.policies)
+        if args.tail_depths:
+            space["tail_depths"] = tuple(args.tail_depths)
         # with a grid axis the base grid is just a placeholder (every config
         # carries its own); devices counts like 8 have no square c=1 face
         grid = (
@@ -185,6 +192,7 @@ def main(argv=None) -> None:
             ("--grids", "grids" in space),
             ("--splits", bool(args.splits)),
             ("--policies", bool(args.policies)),
+            ("--tail-depths", bool(args.tail_depths)),
             ("--top-k", args.top_k != 0),
             ("--layouts", bool(args.layouts)),
             ("--chunks", bool(args.chunks)),
@@ -210,6 +218,7 @@ def main(argv=None) -> None:
             ("--grids", "grids" in space),
             ("--splits", bool(args.splits)),
             ("--policies", bool(args.policies)),
+            ("--tail-depths", bool(args.tail_depths)),
             ("--top-k", args.top_k != 0),
             ("--modes", bool(args.modes)),
             ("--bc", bool(args.bc)),

@@ -476,27 +476,31 @@ def cholinv_space(
     modes: Iterable[str] = ("xla",),
     grids: Iterable[Grid] | None = None,
     balances: Iterable[str] = ("block",),
+    tail_depths: Iterable[int] = (0,),
 ):
-    """policy x bc x split x mode (x grid shape) (x balance) — the
-    reference's decomposition sweep (cholesky tune.cpp:175-253: 3 policies
-    x bcMultiplier range) plus the rep-factor/grid-shape axis (`grids`,
-    e.g. from grid_space()).  The operand reshards to each grid's face on
-    the first in-loop iteration; subsequent iterations carry the face
-    layout, so the measured steady-state time is that grid's.  `balances`
-    adds the schedule axis ('block' / 'tile_cyclic' /
-    'tile_cyclic_persistent', explicit mode only) — the planner prices the
-    copy-bytes difference, so the persistent spelling ranks on the model,
-    not only in the measured sweep."""
+    """policy x bc x split x mode (x grid shape) (x balance)
+    (x tail_fuse_depth) — the reference's decomposition sweep (cholesky
+    tune.cpp:175-253: 3 policies x bcMultiplier range) plus the
+    rep-factor/grid-shape axis (`grids`, e.g. from grid_space()).  The
+    operand reshards to each grid's face on the first in-loop iteration;
+    subsequent iterations carry the face layout, so the measured
+    steady-state time is that grid's.  `balances` adds the schedule axis
+    ('block' / 'tile_cyclic' / 'tile_cyclic_persistent', explicit mode
+    only) — the planner prices the copy-bytes difference, so the
+    persistent spelling ranks on the model, not only in the measured
+    sweep.  `tail_depths` adds the fused-recursion-tail axis
+    (CholinvConfig.tail_fuse_depth; depth 0 = unfused, the default, so
+    existing config ids stay stable)."""
     prec = None if jnp.dtype(dtype).itemsize < 4 else "highest"
     glist = _with_grids(grids, grid)
-    for g, pol, bc, split, mode, bal in itertools.product(
-        glist, policies, bc_dims, splits, modes, balances
+    for g, pol, bc, split, mode, bal, td in itertools.product(
+        glist, policies, bc_dims, splits, modes, balances, tail_depths
     ):
         if bal != "block" and mode != "explicit":
             continue  # balanced schedules are explicit-only (cholesky.factor raises)
         cfg = cholesky.CholinvConfig(
             base_case_dim=bc, split=split, policy=pol, mode=mode,
-            precision=prec, balance=bal,
+            precision=prec, balance=bal, tail_fuse_depth=td,
         )
 
         def step(a, cfg=cfg, g=g):
@@ -506,11 +510,15 @@ def cholinv_space(
         cid = f"pol{pol.value}_bc{bc}_s{split}_{mode}"
         if bal != "block":
             cid += f"_{bal}"
+        if td:
+            cid += f"_tf{td}"
         cdict = {
             "policy": pol.name, "base_case_dim": bc, "split": split, "mode": mode,
         }
         if bal != "block":
             cdict["balance"] = bal
+        if td:
+            cdict["tail_fuse_depth"] = td
         if grids is not None:
             # topology parameters ride the config dict whenever a grids
             # axis was passed — even a single-element axis may differ from

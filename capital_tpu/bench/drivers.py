@@ -317,6 +317,21 @@ def cholinv(args) -> dict:
         return R + Rinv
 
     t, extra = _timed(args, step, A)
+    if getattr(args, "phase_attr", False):
+        # opt-in wall attribution (bench.trace.phase_attribution): the
+        # bubble_frac rides the report line next to the TFLOP/s number and
+        # the phase split rides the ledger record for obs trace-report
+        from capital_tpu.bench import trace as trace_mod
+
+        arun = trace_mod._cholinv_run(
+            args.n, dtype, bc, args.iters, False, cfg.precision, mode=mode
+        )
+        ps, bubble, _wall = trace_mod.phase_attribution(arun, args.iters)
+        extra = {
+            **extra,
+            "bubble_frac": round(bubble, 4),
+            "phase_seconds": {k: round(v, 9) for k, v in ps.items()},
+        }
     flops = 2.0 * args.n**3 / 3.0  # factor n³/3 + triangular inverse n³/3
     rec = harness.report(
         "cholinv_tflops", t, flops, dtype, n=args.n, grid=repr(grid), bc=bc,
@@ -1000,6 +1015,13 @@ def build_parser() -> argparse.ArgumentParser:
         choices=["auto", "vmap", "pallas", "pallas_split"],
         help="posv/lstsq: batched implementation (api.batched impl switch; "
         "auto resolves from the bucket shape like serve does)",
+    )
+    p.add_argument(
+        "--phase-attr", action="store_true",
+        help="cholinv: decompose the measured wall into per-phase seconds "
+        "(bench.trace.phase_attribution) — bubble_frac joins the report "
+        "line and the phase_seconds split rides the ledger record, "
+        "re-readable via obs trace-report",
     )
     p.add_argument(
         "--ledger", default=None,

@@ -32,7 +32,9 @@ import collections
 import glob
 import json
 import os
+import re
 import tempfile
+import time
 
 import jax
 import jax.numpy as jnp
@@ -59,8 +61,14 @@ def _own_times(line):
     """(metadata_id, own_duration_ps) per event: the 'XLA Ops' line is
     hierarchical (a `while` event spans its whole body), so an op's own time
     is its duration minus the durations of the events it directly contains —
-    a stack sweep over (offset, duration)-sorted events."""
-    evs = sorted(line.events, key=lambda e: (e.offset_ps, -e.duration_ps))
+    a stack sweep over (offset, duration)-sorted events.  Accepts either an
+    XLine or a pre-filtered event list (the host-plane fallback filters
+    thread-pool bookkeeping events out BEFORE the sweep, so a listener
+    region can't absorb a real op's duration as its child)."""
+    evs = sorted(
+        getattr(line, "events", line),
+        key=lambda e: (e.offset_ps, -e.duration_ps),
+    )
     out = []
     stack = []  # [end_ps, metadata_id, duration_ps, child_sum]
     for e in evs:
@@ -158,6 +166,13 @@ def device_budget(run, trace_dir: str | None = None) -> dict[str, float]:
     below-floor check (round-3 advisor finding).  Taking the max plane is
     the device-side critical path — the same max-over-ranks convention the
     reference's bench timing uses (bench/cholesky/cholinv.cpp:51-59)."""
+    return _critical_plane_budget(_trace_spaces(run, trace_dir))
+
+
+def _trace_spaces(run, trace_dir: str | None = None):
+    """Trace `run()` once and return the parsed [(path, XSpace)] protos —
+    the raw material shared by device_budget and phase_attribution so a
+    gated CLI invocation profiles exactly once."""
     from tensorflow.tsl.profiler.protobuf import xplane_pb2
 
     with tempfile.TemporaryDirectory() as tmp:
@@ -173,7 +188,7 @@ def device_budget(run, trace_dir: str | None = None) -> dict[str, float]:
             with open(p, "rb") as f:
                 space.ParseFromString(f.read())
             spaces.append((p, space))
-    return _critical_plane_budget(spaces)
+    return spaces
 
 
 def _critical_plane_budget(spaces) -> dict[str, float]:
@@ -228,6 +243,165 @@ def check_copy_fraction(
     return frac
 
 
+# --------------------------------------------------------------------------
+# phase-level wall-time attribution
+# --------------------------------------------------------------------------
+
+#: one optimized-HLO instruction definition with its op_name metadata —
+#: the scope chain tracing.scope stamped through jax.named_scope
+#: survives XLA optimization in exactly this field (fusions inherit a
+#: constituent op's chain).
+_HLO_OP_RE = re.compile(
+    r"%?([A-Za-z0-9_.\-]+)\s*=\s*[^\n]*metadata=\{[^}\n]*op_name=\"([^\"]*)\""
+)
+
+
+def hlo_phase_map(compiled_text: str) -> dict[str, str]:
+    """{instruction name: phase tag ('CI::tmu' form)} from an optimized-HLO
+    dump (``compiled.as_text()``).  Longest registered tag mentioned in the
+    op_name wins (innermost scope, same convention as _bucket); instructions
+    whose op_name names no registered phase are simply absent.  Nested
+    computations are parsed too — dict insertion order means the ENTRY
+    computation (printed last) wins a name collision, which is the
+    computation whose instruction names the runtime's thunk events carry."""
+    out: dict[str, str] = {}
+    tags = sorted(_phase_tags(), key=len)  # ascending: longest match wins
+    for m in _HLO_OP_RE.finditer(compiled_text):
+        name, op_name = m.groups()
+        best = None
+        for tag in tags:
+            if tag in op_name:
+                best = tag
+        if best is not None:
+            out[name] = best.replace(".", "::")
+    return out
+
+
+def _host_plane_budget(spaces, phase_map: dict[str, str]) -> dict[str, float]:
+    """{bucket: ms} fallback for rigs with no device plane (the CPU CI rig):
+    the host trace's XLA-client lines carry one event per executed thunk,
+    named after the entry-computation HLO instruction and stamped with an
+    ``hlo_op`` stat.  Those events are bucketed through `phase_map` (from
+    hlo_phase_map of the SAME compiled program that ran).  Events without
+    the hlo_op stat (ThreadpoolListener / ThunkExecutor bookkeeping) are
+    dropped BEFORE the own-time sweep so they can't swallow op durations.
+    Busiest host plane wins, mirroring _critical_plane_budget."""
+    per_plane: dict[str, dict[str, float]] = collections.defaultdict(
+        lambda: collections.defaultdict(float)
+    )
+    for tag, space in spaces:
+        for plane in space.planes:
+            if "TPU" in plane.name:
+                continue
+            stat_names = {
+                sid: sm.name for sid, sm in plane.stat_metadata.items()
+            }
+            for line in plane.lines:
+                evs = [
+                    e for e in line.events
+                    if any(
+                        stat_names.get(s.metadata_id) == "hlo_op"
+                        for s in e.stats
+                    )
+                ]
+                if not evs:
+                    continue
+                buckets = per_plane[f"{tag}::{plane.name}"]
+                for mid, own_ps in _own_times(evs):
+                    md = plane.event_metadata.get(mid)
+                    if md is None:
+                        continue
+                    name = (md.name or md.display_name).lstrip("%")
+                    key = phase_map.get(name)
+                    if key is None:
+                        if "copy" in name:
+                            key = "copy"
+                        elif "fusion" in name:
+                            key = "fusion"
+                        else:
+                            key = "other"
+                    buckets[key] += own_ps * 1e-9  # ps -> ms
+    if not per_plane:
+        return {}
+    return dict(max(per_plane.values(), key=lambda b: sum(b.values())))
+
+
+def wall_seconds(run, repeats: int = 3) -> float:
+    """min-of-repeats wall clock of one (compiled, warm) run() — the min is
+    the drift-resistant estimator docs/PERF.md's measurement discipline
+    prescribes for walls that only err upward."""
+    best = float("inf")
+    for _ in range(max(1, repeats)):
+        t0 = time.perf_counter()
+        run()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def phase_attribution(run, iters: int, spaces=None, trace_dir=None):
+    """Decompose measured wall-clock into per-phase seconds.
+
+    Returns ``(phase_seconds, bubble_frac, wall_s_per_iter)`` where
+    phase_seconds maps each PHASE_REGISTRY tag (plus the copy/fusion/other
+    catch-alls) to seconds per iteration and
+    ``bubble_frac = max(0, (wall − Σ attributed) / wall)`` — the fraction
+    of the wall no op execution accounts for (launch gaps, host stalls,
+    inter-phase bubbles).  Clamped at 0 because concurrent thunk execution
+    on the CPU rig can legitimately attribute MORE op-seconds than wall.
+
+    Device planes ('XLA Ops' own time) are authoritative when present; on
+    rigs without one, host-side thunk events are bucketed through the
+    compiled module's op_name metadata (``run.compiled`` — the AOT
+    executable every _*_run builder attaches).  Pass `spaces` to reuse an
+    existing _trace_spaces parse; the wall always comes from separate
+    UNtraced runs (profiling overhead must not count as bubble)."""
+    wall = wall_seconds(run) / iters
+    if spaces is None:
+        spaces = _trace_spaces(run, trace_dir)
+    budget = _critical_plane_budget(spaces)
+    budget.pop("async (overlapped)", None)
+    if not budget:
+        compiled = getattr(run, "compiled", None)
+        if compiled is not None:
+            budget = _host_plane_budget(
+                spaces, hlo_phase_map(compiled.as_text())
+            )
+    phase_seconds = {
+        k: v * 1e-3 / iters for k, v in budget.items() if v > 0.0
+    }
+    attributed = sum(phase_seconds.values())
+    bubble = max(0.0, (wall - attributed) / wall) if wall > 0 else 0.0
+    return phase_seconds, bubble, wall
+
+
+def check_bubble_fraction(
+    phase_seconds: dict[str, float],
+    bubble_frac: float,
+    max_frac: float,
+    label: str = "",
+) -> float:
+    """Gate the un-attributed fraction of the wall — the --max-bubble-frac
+    CI mirror of check_copy_fraction.  An EMPTY attribution fails too: a
+    gate that passes because nothing was attributed is a dead gate, and
+    dead gates are how the round-2 copy regressions shipped."""
+    tag = f" ({label})" if label else ""
+    if not phase_seconds:
+        raise RuntimeError(
+            f"bubble gate is dead{tag}: no phase seconds were attributed — "
+            "no device plane in the trace and no compiled module to map "
+            "host events through; fix the attribution before trusting the "
+            "gate"
+        )
+    if bubble_frac > max_frac:
+        raise RuntimeError(
+            f"bubble-budget regression{tag}: {100 * bubble_frac:.1f}% of "
+            f"wall is unattributed (budget {100 * max_frac:.1f}%) — "
+            "inter-phase bubbles / launch gaps grew; see the phase "
+            "breakdown above"
+        )
+    return bubble_frac
+
+
 def print_budget(budget: dict[str, float], iters: int, label: str) -> dict:
     budget = dict(budget)
     async_ms = budget.pop("async (overlapped)", 0.0)
@@ -254,7 +428,23 @@ def print_budget(budget: dict[str, float], iters: int, label: str) -> dict:
     return rec
 
 
-def _cholinv_run(n: int, dtype, bc: int, iters: int, oneshot: bool, prec=None):
+def _aot_run(jitted, *args):
+    """AOT-compile ``jitted(*args)`` and return a zero-arg runner that
+    blocks on the scalar result.  The runner carries the executable as
+    ``run.compiled`` so phase_attribution can read the optimized HLO of
+    EXACTLY the program the trace ran (hlo_phase_map) — a re-jit could
+    legally schedule differently."""
+    compiled = jitted.lower(*args).compile()
+
+    def run():
+        float(compiled(*args))
+
+    run.compiled = compiled
+    return run
+
+
+def _cholinv_run(n: int, dtype, bc: int, iters: int, oneshot: bool, prec=None,
+                 mode: str = "pallas"):
     """The flagship loop (bench.py's shape: fori_loop + element coupling),
     compiled once and traced for `iters` iterations."""
     from capital_tpu.models import cholesky
@@ -262,7 +452,7 @@ def _cholinv_run(n: int, dtype, bc: int, iters: int, oneshot: bool, prec=None):
 
     grid = Grid.square(c=1, devices=[jax.devices()[0]])
     cfg = cholesky.CholinvConfig(
-        base_case_dim=bc, mode="pallas",
+        base_case_dim=bc, mode=mode,
         precision=prec,
         schur_in_place=oneshot,
     )
@@ -301,8 +491,7 @@ def _cholinv_run(n: int, dtype, bc: int, iters: int, oneshot: bool, prec=None):
             )
             return out
 
-        def run():
-            float(loop(eps, iters))
+        run = _aot_run(loop, eps, jnp.int32(iters))
     else:
         from capital_tpu.bench.drivers import _spd
 
@@ -317,10 +506,9 @@ def _cholinv_run(n: int, dtype, bc: int, iters: int, oneshot: bool, prec=None):
 
             return jnp.sum(jax.lax.fori_loop(0, k, body, a), dtype=jnp.float32)
 
-        def run():
-            float(loop(A, eps, iters))
+        run = _aot_run(loop, A, eps, jnp.int32(iters))
 
-    run()  # compile + warm
+    run()  # warm (already AOT-compiled)
     return run
 
 
@@ -345,9 +533,7 @@ def _rectri_run(n: int, dtype, bc: int, iters: int, prec=None):
 
         return jnp.sum(jax.lax.fori_loop(0, k, body, a), dtype=jnp.float32)
 
-    def run():
-        float(loop(T, eps, iters))
-
+    run = _aot_run(loop, T, eps, jnp.int32(iters))
     run()
     return run
 
@@ -378,9 +564,7 @@ def _cacqr_run(m: int, n: int, dtype, bc: int, iters: int, prec=None):
 
         return jnp.sum(jax.lax.fori_loop(0, k, body, a), dtype=jnp.float32)
 
-    def run():
-        float(loop(A, eps, iters))
-
+    run = _aot_run(loop, A, eps, jnp.int32(iters))
     run()
     return run
 
@@ -411,9 +595,7 @@ def _trsm_run(n: int, nrhs: int, dtype, bc: int, iters: int, prec=None):
 
         return jnp.sum(jax.lax.fori_loop(0, k, body, B0), dtype=jnp.float32)
 
-    def run():
-        float(loop((L, B), eps, iters))
-
+    run = _aot_run(loop, (L, B), eps, jnp.int32(iters))
     run()
     return run
 
@@ -436,12 +618,29 @@ def main(argv=None) -> None:
                         "this fraction of device own-time — the CI gate for "
                         "schedule-copy regressions (see "
                         "trace.check_copy_fraction)")
+    p.add_argument("--max-bubble-frac", type=float, default=None,
+                   help="fail (non-zero exit) if more than this fraction of "
+                        "measured wall-clock is attributed to NO phase "
+                        "(launch gaps / host stalls / inter-phase bubbles); "
+                        "also fails when nothing could be attributed at all "
+                        "— no silently-dead gates (trace."
+                        "check_bubble_fraction)")
+    p.add_argument("--ledger", default=None,
+                   help="append one bench:trace:<algo> ledger record "
+                        "carrying the phase_seconds / bubble_frac block "
+                        "(obs diff watches measured.value = attributed "
+                        "fraction for drift)")
     p.add_argument("--precision", default=None,
                    choices=["default", "high", "highest"],
                    help="override the matmul precision ('high' traces the "
                         "f32 3-pass family, 'default' the TPU-default "
                         "1-pass) — same semantics as the drivers CLI")
+    p.add_argument("--platform", default=None,
+                   help="jax platform override (e.g. 'cpu') — config API, "
+                        "same reason as the drivers CLI")
     args = p.parse_args(argv)
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
     dtype = jnp.dtype(args.dtype)
     # ONE precision rule shared with the drivers CLI (drivers._precision):
     # 'default' -> None (TPU default), unset -> the dtype rule
@@ -466,7 +665,8 @@ def main(argv=None) -> None:
         run = _cacqr_run(args.m, args.n, dtype, args.bc, args.iters, prec)
         label = f"cacqr {args.m}x{args.n} {dtype}" + ptag
 
-    budget = device_budget(run, args.trace_dir)
+    spaces = _trace_spaces(run, args.trace_dir)
+    budget = _critical_plane_budget(spaces)
     print_budget(budget, args.iters, label)
     if args.max_copy_frac is not None:
         frac = check_copy_fraction(budget, args.max_copy_frac, label)
@@ -474,6 +674,52 @@ def main(argv=None) -> None:
             f"# copy budget OK: {100 * frac:.1f}% <= "
             f"{100 * args.max_copy_frac:.1f}%"
         )
+    if args.max_bubble_frac is not None or args.ledger is not None:
+        phase_s, bubble, wall = phase_attribution(
+            run, args.iters, spaces=spaces
+        )
+        attributed = sum(phase_s.values())
+        print(
+            f"# phase attribution: wall {wall * 1e3:.3f} ms/iter, "
+            f"attributed {attributed * 1e3:.3f} ms/iter, "
+            f"bubble_frac {bubble:.4f}"
+        )
+        for k, v in sorted(phase_s.items(), key=lambda kv: -kv[1]):
+            print(f"#   {k:16s} {v * 1e3:9.3f} ms/iter")
+        if args.ledger:
+            from capital_tpu.obs import ledger
+
+            meas = {
+                "metric": f"trace_{args.algo}_attributed",
+                # value is the attributed fraction so an obs diff
+                # value-drop reads as "bubbles grew"
+                "value": round(1.0 - bubble, 4),
+                "unit": "frac",
+                "seconds": wall,
+                "n": args.n,
+                "bc": args.bc,
+                "phase_seconds": {k: round(v, 9) for k, v in phase_s.items()},
+                "bubble_frac": round(bubble, 4),
+            }
+            row = ledger.record(
+                f"bench:trace:{args.algo}",
+                ledger.manifest(
+                    dtype=dtype,
+                    config={
+                        "algo": args.algo, "n": args.n, "bc": args.bc,
+                        "iters": args.iters, "oneshot": bool(args.oneshot),
+                    },
+                ),
+                measured=meas,
+            )
+            ledger.append(args.ledger, row)
+            print(f"# ledger: bench:trace:{args.algo} -> {args.ledger}")
+        if args.max_bubble_frac is not None:
+            check_bubble_fraction(phase_s, bubble, args.max_bubble_frac, label)
+            print(
+                f"# bubble budget OK: {100 * bubble:.1f}% <= "
+                f"{100 * args.max_bubble_frac:.1f}%"
+            )
 
 
 if __name__ == "__main__":

@@ -20,7 +20,8 @@ import jax.numpy as jnp
 
 from capital_tpu.lint.program import ProgramTarget
 
-TARGET_NAMES = ("cholinv", "cacqr", "serve", "batched_small", "serve_sched")
+TARGET_NAMES = ("cholinv", "cacqr", "serve", "batched_small", "serve_sched",
+                "cholinv_fused")
 
 
 def _grid():
@@ -138,6 +139,33 @@ def batched_small_targets(
     ]
 
 
+def cholinv_fused_target(n: int = 512, dtype=jnp.float32) -> ProgramTarget:
+    """The fused-recursion-tail cholinv program (CholinvConfig.
+    tail_fuse_depth > 0): n=512 with bc=128 and depth 2 fuses the whole
+    tree into ops/pallas_tpu.fused_tail, putting the ``CI::tail_fused``
+    phase tag under the phase-coverage rule and the fused pallas_call's
+    windowed-output aliasing under cache-key hygiene.  ``flops_audited=
+    False`` because the fused factor+solve sweeps execute inside the
+    interpreted ``pallas_call`` on the CPU lint rig, invisible to XLA
+    ``cost_analysis`` (same reasoning as batched_small_targets)."""
+    from capital_tpu.bench import drivers
+    from capital_tpu.models import cholesky
+
+    grid = _grid()
+    cfg = cholesky.CholinvConfig(
+        base_case_dim=128, mode="pallas", tail_fuse_depth=2,
+    )
+    A = drivers._spd(n, dtype)
+
+    def step(a):
+        R, Rinv = cholesky.factor(grid, a, cfg)
+        return R + Rinv
+
+    return ProgramTarget(
+        name=f"cholinv-fused-n{n}", fn=step, args=(A,), flops_audited=False,
+    )
+
+
 def serve_sched_target(
     n: int = 64, nrhs: int = 4, capacity: int = 4, dtype=jnp.bfloat16,
 ) -> ProgramTarget:
@@ -195,6 +223,8 @@ def flagship_targets(names=None) -> list[ProgramTarget]:
             out.extend(batched_small_targets())
         elif name == "serve_sched":
             out.append(serve_sched_target())
+        elif name == "cholinv_fused":
+            out.append(cholinv_fused_target())
         else:
             raise ValueError(
                 f"unknown lint target {name!r}; expected one of {TARGET_NAMES}"

@@ -121,6 +121,26 @@ class CholinvConfig:
     # standard bench loop carrying A across iterations, or a validation
     # reading A afterwards), XLA inserts a full-buffer copy that costs the
     # memory back plus an HBM pass, which is why this is opt-in.
+    tail_fuse_depth: int = 0  # fuse recursion-tail subtrees into ONE pallas
+    # megakernel (ops/pallas_tpu.fused_tail): any plan() window of size
+    # <= base_case_dim << tail_fuse_depth that passes the trace-time gate
+    # (_tail_fusible: single device, 128-aligned window, VMEM envelope via
+    # batched_small.tail_eligible, f32-or-narrower dtype — f64 always
+    # falls back to the unfused recursion) runs potrf, trsm, syrk and the
+    # inverse-completion trmms as one launch with the panel VMEM-resident
+    # across phases.  0 disables (the default: the fused sweep trades
+    # ~12x executed flops for zero inter-phase HBM/launch cost, a win only
+    # where the tail is latency-bound — autotune sweeps the depth).
+    # depth=1 fuses base-case leaves (5 launches -> 1); each +1 fuses one
+    # more recursion level.  Applies in every mode including the d=1
+    # explicit path; ignored on multi-device grids and under the
+    # persistent tile-cyclic layout.
+    base_prefetch: int = 2  # base-case write-back streams in flight: 2
+    # routes the leaf's R / R⁻¹ transposes through ONE pallas_call with
+    # both output streams live per tile step (pallas_tpu.transpose_pair —
+    # the second stream's block loads overlap the first's compute/store,
+    # and one kernel launch replaces two); 1 keeps the sequential
+    # two-kernel spelling.  Single-device only; bitwise-identical results.
     robust: Optional[RobustConfig] = None  # breakdown DETECTION: factor()
     # returns (R, Rinv, info) with a LAPACK-style int32 status of R
     # (robust/detect.factor_info) instead of NaN-filling silently on a
@@ -308,6 +328,11 @@ def _base_case_into(
             Linv = lax.linalg.triangular_solve(
                 L, jnp.eye(n, dtype=bc_dtype), left_side=True, lower=True
             )
+            if cfg.base_prefetch >= 2:
+                # double-buffered write-back: both transposes in one
+                # launch, two aliased output streams in flight per tile
+                # step (bitwise-identical math — see transpose_pair)
+                return pallas_tpu.transpose_pair(L, Linv, Rp, RIp, dest=dest)
             Rp = pallas_tpu.transpose(L, out_uplo="U", out=Rp, out_off=(dest, dest))
             RIp = pallas_tpu.transpose(
                 Linv, out_uplo="U", out=RIp, out_off=(dest, dest)
@@ -416,6 +441,50 @@ def _scoped_base_factor(
     )(window)
 
 
+def _tail_fusible(
+    grid: Grid,
+    buf: jnp.ndarray,
+    off: int,
+    node: PlanNode,
+    cfg: CholinvConfig,
+    top: bool,
+    Rp: jnp.ndarray,
+    ptile: int,
+) -> bool:
+    """Trace-time gate for collapsing this plan() subtree into the fused
+    megakernel (pallas_tpu.fused_tail).  Every condition is static:
+
+    * the knob is on and the window is within the fused size budget;
+    * single device, block layout (the kernel addresses flat buffers);
+    * a top-level window with complete_inv=False stays unfused (the fused
+      kernel always assembles the full window inverse, which would fill
+      the block the contract promises stays zero);
+    * the window and both destination buffers are 128-lane aligned and
+      whole-block addressable (power-of-two split=1 plans always are;
+      split>=2 subtrees mis-align and fall back — correctly);
+    * dtype within the kernel's f32 compute envelope — f64 falls back to
+      the unfused path AT TRACE TIME, the PR 6 dispatch-gate lesson;
+    * the working set fits VMEM (batched_small.tail_eligible)."""
+    from capital_tpu.ops import batched_small
+
+    if cfg.tail_fuse_depth <= 0:
+        return False
+    if node.n > cfg.base_case_dim << cfg.tail_fuse_depth:
+        return False
+    if grid.num_devices != 1 or ptile:
+        return False
+    if top and not cfg.complete_inv:
+        return False
+    if node.n % 128:
+        return False
+    if (off % node.n or node.off % node.n or buf.shape[0] % node.n
+            or buf.shape[1] % node.n or Rp.shape[0] % node.n):
+        return False
+    if not batched_small.dtype_capable(buf.dtype):
+        return False
+    return batched_small.tail_eligible(node.n, buf.dtype)
+
+
 def _recurse(
     grid: Grid,
     buf: jnp.ndarray,
@@ -426,6 +495,7 @@ def _recurse(
     Rp: jnp.ndarray,
     RIp: jnp.ndarray,
     ptile: int = 0,
+    tail_infos: list | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One recursion window: input is the (off, off, node.n, node.n) window
     of `buf` (upper triangle valid — Schur windows from the uplo='U' syrk
@@ -445,6 +515,20 @@ def _recurse(
     exactly once, in place, and the trmm/syrk operands read straight from
     the buffers through offset index maps (parallel/summa.py views).
     """
+    if _tail_fusible(grid, buf, off, node, cfg, top, Rp, ptile):
+        # the whole subtree — potrf panels, trsm, syrk, inverse-completion
+        # trmms (and for a base node the leaf's five launches) — as ONE
+        # pallas_call with the panel VMEM-resident across phases
+        with tracing.scope("CI::tail_fused"):
+            tracing.emit(flops=tracing.fused_tail_flops(node.n))
+            Rp, RIp, kinfo = pallas_tpu.fused_tail(
+                buf, Rp, RIp, off=off, n=node.n, dest=node.off,
+                precision=cfg.precision,
+            )
+        if tail_infos is not None:
+            tail_infos.append((node.off, node.n, kinfo))
+        return buf, Rp, RIp
+
     if node.is_base:
         Rp, RIp = _base_case_into(
             grid, buf, off, node.n, node.off, cfg, Rp, RIp, ptile
@@ -462,7 +546,9 @@ def _recurse(
     # write consumed it, and XLA would restore single-assignment with a
     # full-buffer copy per spine level (measured: compile-time OOM at
     # n=49152 — 27.02G of 15.75G — from exactly this).
-    buf, Rp, RIp = _recurse(grid, buf, off, left, cfg, False, Rp, RIp, ptile)
+    buf, Rp, RIp = _recurse(
+        grid, buf, off, left, cfg, False, Rp, RIp, ptile, tail_infos
+    )
 
     # balanced schedules for the large explicit-mode windows (see
     # CholinvConfig.balance); summa falls back with a note where the
@@ -516,7 +602,9 @@ def _recurse(
     # mode: S IS the updated buf (the Schur update landed in buf's trailing
     # window), so thread it onward as this node's buffer value.
     s_off = off + n1 if cfg.schur_in_place else 0
-    S, Rp, RIp = _recurse(grid, S, s_off, right, cfg, False, Rp, RIp, ptile)
+    S, Rp, RIp = _recurse(
+        grid, S, s_off, right, cfg, False, Rp, RIp, ptile, tail_infos
+    )
     if cfg.schur_in_place:
         buf = S
 
@@ -546,6 +634,44 @@ def _recurse(
                 cyclic_tile=ptile,
             )
     return buf, Rp, RIp
+
+
+def _combine_tail_info(
+    info: jnp.ndarray, tail_infos: list, n: int
+) -> jnp.ndarray:
+    """Fold the fused-tail kernels' in-kernel info scalars into the global
+    post-hoc status (robust/detect.factor_info of the cropped R).
+
+    This is NOT redundant with factor_info: the fused sweep's guarded
+    rsqrt turns a bad pivot into finite garbage (no NaN fill the post-hoc
+    diagonal scan is guaranteed to see), and when the garbage DOES
+    overflow, the sweep's one-hot outer products turn inf into 0·inf NaNs
+    across the whole window — including rows factored BEFORE the
+    breakdown — so the scan's first-bad-diagonal position inside a broken
+    fused window is backward pollution, not the true pivot.  The kernel's
+    own info is authoritative there: post-hoc pivot positions that fall
+    inside a broken fused window are dropped first, then every window's
+    candidate merges in.  Per window at diagonal offset `dest` with local
+    size nw: local w in [1, nw] maps to global pivot dest+w (1-based,
+    ignored when it falls in the identity pad beyond n); w == nw+1
+    (off-diagonal contamination) maps to the global n+1.  The global
+    status is the FIRST bad pivot — the minimum over all flagged
+    positions, which also ranks any pivot (<= n) above the off-diagonal
+    sentinel n+1, matching the factor_info precedence."""
+    for dest, nw, w in tail_infos:
+        broken = w.astype(info.dtype) > 0
+        inside = (info > dest) & (info <= dest + nw) & (info <= n)
+        info = jnp.where(broken & inside, 0, info)
+    for dest, nw, w in tail_infos:
+        w = w.astype(info.dtype)
+        piv = jnp.where((w > 0) & (w <= nw) & (dest + w <= n), dest + w, 0)
+        offd = jnp.where(w == nw + 1, jnp.asarray(n + 1, info.dtype), 0)
+        cand = jnp.where(piv > 0, piv, offd)
+        info = jnp.where(
+            info == 0, cand,
+            jnp.where(cand == 0, info, jnp.minimum(info, cand)),
+        )
+    return info
 
 
 @pallas_tpu.scoped_by_grid
@@ -590,6 +716,10 @@ def factor(
     # SPD-safe pad: diag(A, I) factors to diag(R, I) without cross-talk.
     Ap = grid.pin(pad_embed_identity(A, n, p))
     node = plan(p, cfg)
+    # fused-tail windows report breakdown through in-kernel info scalars
+    # (collected at trace time, combined with the post-hoc scan below —
+    # the guarded sweep produces no NaNs for factor_info to catch)
+    tail_infos: list | None = [] if cfg.robust is not None else None
 
     # persistent tile-cyclic layout: permute ONCE here (V = Ap[perm][:, perm]
     # — a symmetric permutation, so SPD and the triangular-R contract of the
@@ -636,7 +766,9 @@ def factor(
             RIp = grid.pin(RIp[pj][:, pj])
             cbytes, ncoll = tracing.transpose_cost(grid, p, p, Rp.dtype)
             tracing.emit(comm_bytes=2 * cbytes, collectives=2 * ncoll)
-        _, R, Rinv = _recurse(grid, Ap, 0, node, cfg, True, Rp, RIp, ptile)
+        _, R, Rinv = _recurse(
+        grid, Ap, 0, node, cfg, True, Rp, RIp, ptile, tail_infos
+    )
         if ptile:
             R = R[unperm][:, unperm]
             Rinv = Rinv[unperm][:, unperm]
@@ -644,7 +776,10 @@ def factor(
         if p != n:
             R, Rinv = R[:n, :n], Rinv[:n, :n]
         if cfg.robust is not None:
-            return R, Rinv, detect.factor_info(R)
+            info = detect.factor_info(R)
+            if tail_infos:
+                info = _combine_tail_info(info, tail_infos, n)
+            return R, Rinv, info
         return R, Rinv
 
     tile = _zeros_plan(grid, node, cfg)
@@ -670,7 +805,9 @@ def factor(
     else:
         Rp = grid.pin(jnp.zeros((p, p), dtype=A.dtype))
         RIp = grid.pin(jnp.zeros((p, p), dtype=A.dtype))
-    _, R, Rinv = _recurse(grid, Ap, 0, node, cfg, True, Rp, RIp, ptile)
+    _, R, Rinv = _recurse(
+        grid, Ap, 0, node, cfg, True, Rp, RIp, ptile, tail_infos
+    )
     if ptile:
         R = R[unperm][:, unperm]
         Rinv = Rinv[unperm][:, unperm]
@@ -678,7 +815,10 @@ def factor(
     if p != n:
         R, Rinv = R[:n, :n], Rinv[:n, :n]
     if cfg.robust is not None:
-        return R, Rinv, detect.factor_info(R)
+        info = detect.factor_info(R)
+        if tail_infos:
+            info = _combine_tail_info(info, tail_infos, n)
+        return R, Rinv, info
     return R, Rinv
 
 

@@ -22,6 +22,11 @@ p99 latency — the second half of ``make serve-smoke``.
 (capital_tpu.lint CLI; docs/STATIC_ANALYSIS.md) and gates on each report's
 own pass/fail outcome — the second half of ``make lint``.
 
+``trace-report`` summarizes the phase-attribution records of a ledger
+(bench:trace:* producers; bench/trace.phase_attribution) — the per-phase
+wall split plus bubble_frac — and optionally gates on bubble_frac
+(docs/OBSERVABILITY.md "Phase-level wall-time attribution").
+
 Examples::
 
     python -m capital_tpu.obs audit cholinv --n 4096
@@ -349,6 +354,65 @@ def _lint_report(args) -> int:
     return 0
 
 
+def _trace_report(args) -> int:
+    """Summarize the phase-attribution records of a ledger (bench:trace
+    producers).  Exit 2 on a malformed phase_seconds block, 1 on a gate
+    failure — including a requested gate with no records to exercise it
+    (same no-silently-dead-gates posture as serve-report's split gates)."""
+    from capital_tpu.obs import ledger
+
+    recs = ledger.read(args.ledger)
+    rows = [
+        r for r in recs
+        if isinstance(r.get("measured"), dict)
+        and r["measured"].get("phase_seconds") is not None
+    ]
+    bad = 0
+    for i, r in enumerate(rows):
+        for p in ledger.validate_phase_seconds(r["measured"]):
+            print(f"malformed phase attribution record #{i}: {p}",
+                  file=sys.stderr)
+            bad += 1
+    if bad:
+        return 2
+    if not rows:
+        print(f"# no phase_seconds records in {args.ledger} "
+              f"({len(recs)} records total)")
+        return 1 if args.max_bubble_frac is not None else 0
+    failures = []
+    for i, r in enumerate(rows):
+        meas = r["measured"]
+        man = r.get("manifest") or {}
+        ps = meas["phase_seconds"]
+        total = sum(ps.values())
+        bf = meas.get("bubble_frac")
+        print(
+            f"# [{i}] {r.get('kind', '?')} {man.get('platform', '?')}/"
+            f"{man.get('device', '?')} n={meas.get('n', '?')} "
+            f"attributed={total * 1e3:.3f} ms/iter "
+            f"bubble_frac={bf if bf is not None else '?'}"
+        )
+        for tag, v in sorted(ps.items(), key=lambda kv: -kv[1]):
+            pct = 100 * v / total if total > 0 else 0.0
+            print(f"#     {tag:16s} {v * 1e3:9.3f} ms/iter  {pct:5.1f}%")
+        if args.max_bubble_frac is not None:
+            if bf is None:
+                failures.append(
+                    f"record #{i}: carries phase_seconds but no bubble_frac"
+                )
+            elif bf > args.max_bubble_frac:
+                failures.append(
+                    f"record #{i}: bubble_frac {bf} > {args.max_bubble_frac} "
+                    "(unattributed wall grew — see the phase split above)"
+                )
+    for f in failures:
+        print(f"trace-report gate FAIL: {f}", file=sys.stderr)
+    if failures:
+        return 1
+    print(f"# trace-report OK ({len(rows)} phase-attribution record(s))")
+    return 0
+
+
 def _diff(args) -> int:
     from capital_tpu.obs import ledger
 
@@ -448,6 +512,17 @@ def build_parser() -> argparse.ArgumentParser:
                     help="fail unless a record for this pass exists "
                          "(repeatable: program, source)")
     lr.set_defaults(fn=_lint_report)
+
+    tr = sub.add_parser(
+        "trace-report",
+        help="summarize phase-attribution records (per-phase wall split "
+             "+ bubble_frac, optional gate)",
+    )
+    tr.add_argument("ledger")
+    tr.add_argument("--max-bubble-frac", type=float, default=None,
+                    help="fail when any record's bubble_frac exceeds this, "
+                         "or when no record carries phase_seconds at all")
+    tr.set_defaults(fn=_trace_report)
 
     g = sub.add_parser(
         "robust-gate",

@@ -363,6 +363,53 @@ def validate_lint_report(block) -> list[str]:
     return probs
 
 
+def validate_phase_seconds(measured) -> list[str]:
+    """Schema problems of a measured block carrying phase attribution
+    ([] = valid) — the bench:trace producer's ``phase_seconds`` /
+    ``bubble_frac`` fields (bench/trace.phase_attribution).  Same posture
+    as request_stats / lint_report: structurally validated on every diff
+    whenever PRESENT, never required — records that predate the fields
+    stay valid unchanged.  The per-phase split itself is workload shape,
+    not a metric; its drift gate is measured.value (the attributed
+    fraction), which diff() compares normally."""
+    if not isinstance(measured, dict):
+        return [f"measured is {type(measured).__name__}, expected object"]
+    probs = []
+    ps = measured.get("phase_seconds")
+    if ps is not None:
+        if not isinstance(ps, dict):
+            probs.append(f"phase_seconds must be an object, got {ps!r}")
+        else:
+            for tag, v in ps.items():
+                if not isinstance(tag, str) or not tag:
+                    probs.append(f"phase_seconds key {tag!r} not a string")
+                if (
+                    not isinstance(v, (int, float))
+                    or isinstance(v, bool)
+                    or not v >= 0.0
+                    or v != v
+                    or v == float("inf")
+                ):
+                    probs.append(
+                        f"phase_seconds[{tag!r}] must be a finite "
+                        f"non-negative number, got {v!r}"
+                    )
+    bf = measured.get("bubble_frac")
+    if bf is not None:
+        if (
+            not isinstance(bf, (int, float))
+            or isinstance(bf, bool)
+            or not 0.0 <= bf <= 1.0
+        ):
+            probs.append(f"bubble_frac must be in [0, 1], got {bf!r}")
+        if ps is None:
+            probs.append(
+                "bubble_frac without phase_seconds — the fraction is "
+                "meaningless without the attribution that produced it"
+            )
+    return probs
+
+
 def _event_status(rec: dict) -> Optional[str]:
     """The robustness status of a record, when it carries one.
 
@@ -432,6 +479,15 @@ def diff(
             if probs:
                 raise LedgerIncompatible(
                     "malformed lint_report record: " + "; ".join(probs)
+                )
+        meas = r.get("measured")
+        if isinstance(meas, dict) and (
+            "phase_seconds" in meas or "bubble_frac" in meas
+        ):
+            probs = validate_phase_seconds(meas)
+            if probs:
+                raise LedgerIncompatible(
+                    "malformed phase attribution record: " + "; ".join(probs)
                 )
     a_by = {_key(r): r for r in a_recs}
     b_by = {_key(r): r for r in b_recs}

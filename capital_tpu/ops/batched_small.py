@@ -115,6 +115,24 @@ def eligible(op: str, a_shape: tuple, b_shape: tuple | None, dtype,
     return need <= limit
 
 
+def tail_eligible(n: int, dtype, *, interpret: bool | None = None) -> bool:
+    """VMEM-envelope gate for the fused recursion-tail megakernel
+    (pallas_tpu.fused_tail): one (n, n) window at `dtype` in, two (n, n)
+    windows out, plus the f32 working set of the in-kernel sweep — the
+    symmetrized copy, the live factor, its inverse, and the fori_loop's
+    rank-1 temporaries (~5 f32 matrices, conservatively).  Same 0.85x
+    budget headroom and interpret-mode bypass as `eligible` — CPU CI must
+    ride the same fused route the hardware does."""
+    if interpret is None:
+        interpret = _interpret_default()
+    if interpret:
+        return True
+    limit = 0.85 * (_device_budget()[1] or (16 << 20))
+    item = jnp.dtype(dtype).itemsize
+    need = 3 * n * n * item + 4 * (5 * n * n)
+    return need <= limit
+
+
 def dtype_capable(dtype) -> bool:
     """Whether the batched-grid kernels can serve this dtype without
     precision loss.  They compute in f32 (Mosaic's accumulator width), so

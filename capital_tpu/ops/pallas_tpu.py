@@ -515,7 +515,13 @@ def sched_matmul(
         interpret=interpret,
         compiler_params=jax_compat.pallas_compiler_params(
             pltpu,
-            dimension_semantics=("arbitrary", "arbitrary"),
+            # q sweeps distinct output tiles of the dense side — no
+            # cross-step VMEM state, so it is parallel (same semantics as
+            # the static trmm_kernel below); only the pair dimension p
+            # carries the accumulator and must stay sequential.  Parallel
+            # outer steps let Mosaic prefetch the next q's blocks while the
+            # current accumulation runs instead of serializing the sweep.
+            dimension_semantics=("parallel", "arbitrary"),
             vmem_limit_bytes=vmem_limit,
         ),
     )(to, ko, first, last, A, B)
@@ -664,6 +670,192 @@ def transpose(
         interpret=interpret,
     )(*operands)
     return res
+
+
+def transpose_pair(
+    L: jnp.ndarray,
+    Linv: jnp.ndarray,
+    Rp: jnp.ndarray,
+    RIp: jnp.ndarray,
+    *,
+    dest: int,
+    interpret: bool | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Both base-case write-back transposes in ONE pallas_call: Lᵀ masked to
+    'U' lands in `Rp` at (dest, dest), Linvᵀ in `RIp`, each through its own
+    input_output_alias (untouched regions preserved; the caller must treat
+    the passed-in buffers as consumed).
+
+    This is the double-buffered form of the two sequential `transpose`
+    calls `_base_case_into` used to issue: one grid sweep keeps BOTH
+    write-back DMA streams in flight per tile step (the second stream's
+    block loads overlap the first's compute/store) and drops a whole kernel
+    launch from every leaf.  Math is identical per tile — same `.T`, same
+    `_global_tri_mask`, same single output cast — so the results are
+    bitwise-equal to the unpaired spelling.  Falls back to two `transpose`
+    calls when the window/offset cannot tile."""
+    if interpret is None:
+        interpret = _interpret_default()
+    n = L.shape[0]
+    if L.shape != (n, n) or Linv.shape != (n, n) or Rp.shape != RIp.shape:
+        raise ValueError(
+            f"transpose_pair wants square panels and matching buffers, got "
+            f"L{L.shape} Linv{Linv.shape} Rp{Rp.shape} RIp{RIp.shape}"
+        )
+    bm = _fit_block(512, n, dest)
+    bn = _fit_block(512, n, dest)
+    if bm == 0 or bn == 0:
+        Rp = transpose(L, out_uplo="U", out=Rp, out_off=(dest, dest),
+                       interpret=interpret)
+        RIp = transpose(Linv, out_uplo="U", out=RIp, out_off=(dest, dest),
+                        interpret=interpret)
+        return Rp, RIp
+
+    def kernel(l_ref, li_ref, rp_ref, rip_ref, r_out, ri_out):
+        del rp_ref, rip_ref  # aliased storage; never read
+        i, j = pl.program_id(0), pl.program_id(1)
+        t = _global_tri_mask(l_ref[:].T, i * bn, j * bm, "U")
+        u = _global_tri_mask(li_ref[:].T, i * bn, j * bm, "U")
+        r_out[:] = t.astype(r_out.dtype)
+        ri_out[:] = u.astype(ri_out.dtype)
+
+    oo = (dest // bn, dest // bm)
+    return pl.pallas_call(
+        kernel,
+        grid=(n // bn, n // bm),
+        in_specs=[
+            pl.BlockSpec((bm, bn), lambda i, j: (j, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bm, bn), lambda i, j: (j, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec(
+                (bn, bm), lambda i, j: (i + oo[0], j + oo[1]),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (bn, bm), lambda i, j: (i + oo[0], j + oo[1]),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(Rp.shape, Rp.dtype),
+            jax.ShapeDtypeStruct(RIp.shape, RIp.dtype),
+        ],
+        input_output_aliases={2: 0, 3: 1},
+        interpret=interpret,
+    )(L, Linv, Rp, RIp)
+
+
+def fused_tail(
+    buf: jnp.ndarray,
+    Rp: jnp.ndarray,
+    RIp: jnp.ndarray,
+    *,
+    off: int,
+    n: int,
+    dest: int,
+    block: int = 0,
+    precision: str | None = "highest",
+    interpret: bool | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """An ENTIRE cholinv recursion subtree as ONE pallas_call: reads the
+    (off, off, n, n) window of `buf` (upper triangle valid), factors it
+    A = RᵀR and inverts the factor, writing triu(R) / triu(R⁻¹) into the
+    (dest, dest, n, n) windows of `Rp` / `RIp` in place (aliased — callers
+    must treat the passed-in buffers as consumed).  Returns
+    (Rp, RIp, info) with info a scalar int32 in the potrf 0/k/n+1
+    convention, computed in-kernel (O(n²) next to the O(n³) sweep).
+
+    Why one kernel subsumes the whole subtree: the recursion's potrf
+    panels, trsm panels, syrk trailing updates and inverse-completion
+    trmms are algebraically a blocked elimination of the window — and the
+    masked column sweep (`batched_small._chol`, rank-1 updates through
+    one-hot contractions) IS that elimination at block size 1, while the
+    back-substitution of the identity (`_bwd_solve`) assembles R⁻¹ the
+    same way the completion trmms do.  Executing it as one kernel keeps
+    the panel VMEM-resident across every phase boundary: no HBM
+    round-trip between potrf/trsm/syrk/trmm, no per-phase launch, no
+    schedule-inserted copies at the seams.  The sweep executes ~12n³
+    flops against the ~n³ useful count (tracing.fused_tail_flops) — the
+    same latency-over-throughput trade the batched small-N kernels make,
+    and the reason the `tail_fuse_depth` gate keeps n small.
+
+    The caller gates eligibility (`models/cholesky._tail_fusible`:
+    alignment, VMEM envelope via `batched_small.tail_eligible`, dtype —
+    f64 falls back to the unfused recursion at trace time).  Alignment
+    contract here: off, dest and both buffer dims must be multiples of n
+    (the window is addressed as one whole BlockSpec block)."""
+    if interpret is None:
+        interpret = _interpret_default()
+    if (off % n or dest % n or buf.shape[0] % n or buf.shape[1] % n
+            or Rp.shape[0] % n or Rp.shape[1] % n or Rp.shape != RIp.shape):
+        raise ValueError(
+            f"fused_tail alignment: off={off} dest={dest} n={n} "
+            f"buf{buf.shape} Rp{Rp.shape} RIp{RIp.shape} must all be "
+            "multiples of the window"
+        )
+    # lazy imports: batched_small imports this module at top level (the
+    # shared precision_dot / budget helpers), so the building-block reuse
+    # must run the other way at call time
+    from capital_tpu.ops import batched_small
+    from capital_tpu.utils import tracing
+
+    bs = batched_small._resolve_block(n, block)
+    io, do = off // n, dest // n
+
+    def kernel(w_ref, rp_ref, rip_ref, r_out, ri_out, info_ref):
+        del rp_ref, rip_ref  # aliased storage; never read
+        w = w_ref[:].astype(jnp.float32)
+        r = jax.lax.broadcasted_iota(jnp.int32, (n, n), 0)
+        c = jax.lax.broadcasted_iota(jnp.int32, (n, n), 1)
+        # symmetrize from the valid upper half (Schur windows carry only it)
+        S = jnp.where(r <= c, w, w.T)
+        R, info = batched_small._chol(
+            S, uplo="U", block=bs, precision=precision
+        )
+        eye = (r == c).astype(jnp.float32)
+        Rinv = batched_small._bwd_solve(
+            R, eye, from_upper=True, block=bs, precision=precision
+        )
+        upper = r <= c
+        r_out[:] = jnp.where(upper, R, 0.0).astype(r_out.dtype)
+        ri_out[:] = jnp.where(upper, Rinv, 0.0).astype(ri_out.dtype)
+        info_ref[0, 0] = info
+
+    Rp2, RIp2, info = pl.pallas_call(
+        kernel,
+        grid=(1,),
+        in_specs=[
+            pl.BlockSpec((n, n), lambda q: (io, io), memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec((n, n), lambda q: (do, do), memory_space=pltpu.VMEM),
+            pl.BlockSpec((n, n), lambda q: (do, do), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda q: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(Rp.shape, Rp.dtype),
+            jax.ShapeDtypeStruct(RIp.shape, RIp.dtype),
+            jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        ],
+        input_output_aliases={1: 0, 2: 1},
+        cost_estimate=pl.CostEstimate(
+            flops=int(tracing.fused_tail_flops(n)),
+            bytes_accessed=3 * n * n * jnp.dtype(Rp.dtype).itemsize,
+            transcendentals=n,
+        ),
+        compiler_params=jax_compat.pallas_compiler_params(
+            pltpu,
+            dimension_semantics=("arbitrary",),
+            vmem_limit_bytes=_device_budget()[1],
+        ),
+        interpret=interpret,
+    )(buf, Rp, RIp)
+    return Rp2, RIp2, info[0, 0]
 
 
 # NOTE: deliberately NOT wrapped in jax.jit.  The in-place `out` path decides

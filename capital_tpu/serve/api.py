@@ -223,14 +223,19 @@ def batched(op: str, precision: str | None = "highest",
     return auto
 
 
-def single(op: str, grid, precision: str | None = "highest", robust=None):
+def single(op: str, grid, precision: str | None = "highest", robust=None,
+           tail_fuse_depth: int = 0):
     """The oversize route: one exact-shape problem through the models/
     schedules on the engine's grid.  Uniform return contract (X, info):
     info is a scalar int32 (posv/inv) or a RobustInfo pytree (lstsq under
     robust); jnp.int32(0) when robust is None (the engine ignores it then).
+    `tail_fuse_depth` threads ServeConfig's fused-recursion-tail knob into
+    every CholinvConfig built here — it changes the compiled program, so
+    the engine keys it into the cache config-hash.
     """
     if op == "posv":
-        ccfg = cholesky.CholinvConfig(precision=precision, robust=robust)
+        ccfg = cholesky.CholinvConfig(precision=precision, robust=robust,
+                                      tail_fuse_depth=tail_fuse_depth)
 
         def f(a, b):
             out = cholesky.solve(grid, a, b, ccfg)
@@ -240,7 +245,8 @@ def single(op: str, grid, precision: str | None = "highest", robust=None):
     if op == "lstsq":
         qcfg = qr.CacqrConfig(
             precision=precision, robust=robust,
-            cholinv=cholesky.CholinvConfig(precision=precision),
+            cholinv=cholesky.CholinvConfig(precision=precision,
+                                           tail_fuse_depth=tail_fuse_depth),
         )
 
         def f(a, b):
@@ -254,7 +260,8 @@ def single(op: str, grid, precision: str | None = "highest", robust=None):
 
         return f
     if op == "inv":
-        ccfg = cholesky.CholinvConfig(precision=precision, robust=robust)
+        ccfg = cholesky.CholinvConfig(precision=precision, robust=robust,
+                                      tail_fuse_depth=tail_fuse_depth)
 
         def f(a):
             if robust is not None:

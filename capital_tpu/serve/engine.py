@@ -92,6 +92,12 @@ class ServeConfig:
         LAPACK); 'vmap' / 'pallas' / 'pallas_split' force one route for
         every bucket.  Joins the config hash — two engines differing here
         compile different programs and must never share cache entries.
+    tail_fuse_depth: CholinvConfig.tail_fuse_depth for the oversize single
+        route (fused recursion tail, ops/pallas_tpu.fused_tail; 0 =
+        unfused).  Joins the config hash: a fused and an unfused engine
+        compile different programs and must never share cache entries —
+        the zero-recompile smoke stays green precisely because the knob
+        is keyed, not hidden.
     scheduler: 'continuous' (default) overlaps staging/dispatch/landing
         across consecutive buckets (serve/scheduler.py); 'sync' is the
         PR 4 stop-and-go flush, kept as the loadgen A/B baseline.  NOT in
@@ -115,6 +121,7 @@ class ServeConfig:
     donate: Optional[bool] = None
     oversize: str = "models"
     small_n_impl: str = "auto"
+    tail_fuse_depth: int = 0
     scheduler: str = "continuous"
     max_inflight: int = 2
     persist_dir: Optional[str] = None
@@ -165,7 +172,7 @@ class SolveEngine:
         # when and where programs run, never what was compiled.
         ident = repr((cfg.buckets, cfg.rows_buckets, cfg.nrhs_buckets,
                       cfg.max_batch, cfg.precision, cfg.robust,
-                      cfg.small_n_impl))
+                      cfg.small_n_impl, cfg.tail_fuse_depth))
         self._cfg_hash = hashlib.sha1(ident.encode()).hexdigest()[:12]
         self._grid_key = (self.grid.dx, self.grid.dy, self.grid.c,
                           self.grid.platform)
@@ -239,7 +246,8 @@ class SolveEngine:
 
         def build():
             fn = api.single(op, self.grid, self.cfg.precision,
-                            self.cfg.robust)
+                            self.cfg.robust,
+                            tail_fuse_depth=self.cfg.tail_fuse_depth)
             specs = (a_sds,) if b_sds is None else (a_sds, b_sds)
             return jax.jit(fn).lower(*specs).compile()
 

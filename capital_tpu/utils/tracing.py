@@ -115,6 +115,12 @@ PHASE_REGISTRY: tuple[str, ...] = (
     # phase-coverage rule and the trace tool attribute it instead of
     # bucketing kernel writes under 'other'.
     "CI::factor_diag", "CI::trsm", "CI::tmu", "CI::inv", "CI::buffers",
+    # CI::tail_fused is the fused recursion-tail megakernel
+    # (ops/pallas_tpu.fused_tail): an entire plan() subtree — potrf panel,
+    # trsm, syrk trailing update, inverse-assembly trmm — lowered as ONE
+    # pallas_call with the panel VMEM-resident across phases.  One phase,
+    # one price, same rationale as SV::fused_posv.
+    "CI::tail_fused",
     # cacqr (qr.py, reference cacqr.hpp:82-116; CQR::scale is historical —
     # kept so old traces/ledgers still bucket).  CQR::recover is the
     # shifted-CholeskyQR escalation path (robust/recovery.py) — present in
@@ -475,6 +481,17 @@ def fused_posv_flops(n: int, k: int) -> float:
     """Fused factor + two substitution sweeps, per problem (SV::fused_posv):
     the factor never leaves VMEM, so this is one phase, one price."""
     return batched_chol_flops(n) + 2.0 * batched_trsm_flops(n, k)
+
+
+def fused_tail_flops(n: int) -> float:
+    """Fused recursion-tail megakernel, whole subtree (CI::tail_fused):
+    an (n,n) window factored by the masked column-sweep (guarded-rsqrt
+    rank-1 updates, ~6n³ executed like batched_chol_flops) plus the
+    back-substitution inverse of the n-wide identity (one masked trsm
+    sweep at k=n).  Counts EXECUTED kernel flops — the sweep subsumes the
+    subtree's potrf/trsm/syrk/trmm phases, so this single price replaces
+    every per-phase emit the unfused recursion would have issued."""
+    return batched_chol_flops(n) + batched_trsm_flops(n, n)
 
 
 def fused_lstsq_flops(m: int, n: int, k: int) -> float:

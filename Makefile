@@ -6,7 +6,7 @@
 PY ?= python
 
 .PHONY: all test benchmarking bench-explicit bench-small tune audit lint \
-	robust serve-smoke serve-bench native clean
+	robust serve-smoke serve-bench serve-replicas native clean
 
 all: test
 
@@ -56,7 +56,7 @@ bench-small:
 # through obs trace-report — the same double-entry discipline as lint.
 # The generous 0.995 bound absorbs CPU-interpret emulation; what it pins
 # is that attribution works end to end.
-audit: serve-smoke serve-bench lint
+audit: serve-smoke serve-bench serve-replicas lint
 	$(PY) -m capital_tpu.obs audit cholinv --n 4096 --platform cpu
 	$(PY) -m capital_tpu.obs audit cacqr --m 16384 --n 512 --platform cpu
 	$(PY) -m capital_tpu.obs robust-gate --platform cpu
@@ -120,6 +120,29 @@ serve-bench:
 		--min-hit-rate 1.0 --min-occupancy 0.25 \
 		--max-queue-wait-ms 60000
 
+# multi-replica serving smoke (docs/SERVING.md "Multi-replica serving"):
+# 2 replicas behind the router sharing one persistent cache dir.  The COLD
+# run warms the shared disk tier and proves the failure paths: an induced
+# replica kill (in-flight requests re-dispatched, the replacement replica
+# warms from disk, not by compiling) and an induced drain + resume under
+# load — gated inside the smoke on zero dropped requests and zero
+# steady-state recompiles.  The WARM run re-runs drain-only with
+# --max-compiles 0: every replica must deserialize its whole ladder from
+# the shared dir.  serve-report --aggregate then re-gates the ledger:
+# >= 2 distinct replica tags (the it-really-was-multi-replica check) and
+# aggregate hit-rate 1.0 across the merged records
+serve-replicas:
+	rm -f serve_replicas.jsonl
+	rm -rf serve_replicas_cache
+	$(PY) -m capital_tpu.serve replicas --platform cpu --replicas 2 \
+		--requests 48 --persist-dir serve_replicas_cache \
+		--kill-one --drain-one --ledger serve_replicas.jsonl
+	$(PY) -m capital_tpu.serve replicas --platform cpu --replicas 2 \
+		--requests 48 --persist-dir serve_replicas_cache \
+		--drain-one --max-compiles 0 --ledger serve_replicas.jsonl
+	$(PY) -m capital_tpu.obs serve-report serve_replicas.jsonl \
+		--aggregate --min-replicas 2 --min-hit-rate 1.0
+
 # breakdown detection / shifted-CholeskyQR recovery / fault-injection suite
 # (docs/ROBUSTNESS.md); CPU rig — tests/conftest.py provides the 8-device
 # virtual mesh and enables x64
@@ -132,5 +155,5 @@ native:
 clean:
 	rm -rf autotune_out .pytest_cache bench_explicit.jsonl serve_smoke.jsonl \
 		lint_report.jsonl bench_small.jsonl serve_bench.jsonl serve_cache \
-		bench_trace.jsonl
+		bench_trace.jsonl serve_replicas.jsonl serve_replicas_cache
 	find . -name __pycache__ -type d -exec rm -rf {} +

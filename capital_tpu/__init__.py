@@ -34,4 +34,14 @@ Package layout:
 
 __version__ = "0.1.0"
 
-from capital_tpu.parallel.topology import Grid  # noqa: F401
+
+def __getattr__(name: str):
+    # Grid resolves lazily (PEP 562): importing it pulls in jax, and the
+    # host-only serve processes (router pumps, spawned loadgen clients)
+    # import this package without ever needing a device runtime.
+    if name == "Grid":
+        from capital_tpu.parallel.topology import Grid
+
+        globals()["Grid"] = Grid
+        return Grid
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

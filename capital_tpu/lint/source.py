@@ -20,6 +20,14 @@
   ``tap(point=...)`` must be in `tracing.PHASE_REGISTRY`.  scope() refuses
   unknown tags at trace time; this rule moves the failure to lint time,
   before a rarely-traced branch ships the ValueError to production.
+* ``host-only-dispatch`` — the serve dispatch plane (``serve/router.py``,
+  ``serve/replica.py``) must not import jax at module level: the router
+  and its spawned client/worker shims run in processes that either never
+  need a device runtime (pure host-side dispatch) or must apply their env
+  overrides BEFORE jax initializes (the ProcessReplica spawn contract).
+  Engine access goes through the lazy in-worker import; a module-level
+  ``import jax`` here silently re-couples the dispatch plane to the
+  device runtime.
 
 Pure stdlib ``ast`` — no file is imported, so linting broken code or code
 with heavy import side effects is safe.
@@ -38,10 +46,23 @@ BARE_EXCEPT = "bare-except"
 BROAD_EXCEPT = "broad-except"
 COMPUTE_OUTSIDE_SCOPE = "compute-outside-scope"
 UNREGISTERED_PHASE_TAG = "unregistered-phase-tag"
+HOST_ONLY_DISPATCH = "host-only-dispatch"
 
 SOURCE_RULES = (
     BARE_EXCEPT, BROAD_EXCEPT, COMPUTE_OUTSIDE_SCOPE, UNREGISTERED_PHASE_TAG,
+    HOST_ONLY_DISPATCH,
 )
+
+#: Files (path suffixes) that form the serve dispatch plane: host-only by
+#: contract, no module-level jax import allowed.
+HOST_ONLY_FILES = (
+    os.path.join("serve", "router.py"),
+    os.path.join("serve", "replica.py"),
+)
+
+#: Module roots whose import at module level couples a file to the device
+#: runtime (jax itself and its subpackages).
+_DEVICE_ROOTS = frozenset({"jax", "jaxlib"})
 
 #: FLOP-bearing jnp/lax entry points (mirrors program.FLOP_PRIMITIVES at the
 #: API level: what lowers to those primitives).
@@ -209,6 +230,39 @@ def lint_source(path: str, text: Optional[str] = None) -> list[rules.Finding]:
                     "register_phase) so downstream views can bucket it",
                     line=lit[1],
                 ))
+
+    # -- host-only-dispatch: module-level device-runtime imports -----------
+    norm = os.path.normpath(path)
+    if any(norm.endswith(sfx) for sfx in HOST_ONLY_FILES):
+        def _import_roots(node: ast.AST) -> list[tuple[str, int]]:
+            if isinstance(node, ast.Import):
+                return [(a.name.split(".")[0], node.lineno)
+                        for a in node.names]
+            if isinstance(node, ast.ImportFrom) and node.module:
+                return [(node.module.split(".")[0], node.lineno)]
+            return []
+
+        def scan_module_level(node: ast.AST) -> None:
+            # function bodies are exempt: the lazy in-worker import (after
+            # the spawn child applies its env overrides) is the sanctioned
+            # way to reach the engine from the dispatch plane
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                    continue
+                for root, lineno in _import_roots(child):
+                    if root in _DEVICE_ROOTS and not _suppressed(lineno):
+                        findings.append(rules.make(
+                            HOST_ONLY_DISPATCH, rules.ERROR, path,
+                            f"module-level `{root}` import in the serve "
+                            "dispatch plane — router/replica must stay "
+                            "host-only (import lazily inside the worker, "
+                            "after env overrides apply)",
+                            line=lineno,
+                        ))
+                scan_module_level(child)
+
+        scan_module_level(tree)
 
     # -- compute-outside-scope: recursive walk with scope context ----------
     if _in_scoped_dir(path):

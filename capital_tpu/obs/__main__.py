@@ -214,7 +214,9 @@ def _serve_report(args) -> int:
                 or args.max_p99_ms is not None
                 or args.max_p99_ms_small is not None
                 or args.min_occupancy is not None
-                or args.max_queue_wait_ms is not None)
+                or args.max_queue_wait_ms is not None
+                or args.min_replicas is not None
+                or args.aggregate)
     if not rows:
         print(f"# no request_stats records in {args.ledger} "
               f"({len(recs)} records total)")
@@ -290,6 +292,62 @@ def _serve_report(args) -> int:
             "--max-p99-ms-small requested but no record carries a "
             "latency_ms_small block (no small-bucket traffic served?)"
         )
+    # cross-replica aggregation (docs/SERVING.md "Multi-replica serving"):
+    # fold every replica-TAGGED record through stats.merge_snapshots and
+    # report the fleet view — summed counts, worst tail, summed router-block
+    # QPS, and a per-replica occupancy table.  --min-replicas is the
+    # it-really-was-multi-replica gate: it fails loudly when the ledger
+    # carries fewer distinct replica tags than claimed (or none at all).
+    if args.aggregate or args.min_replicas is not None:
+        from capital_tpu.serve import stats as serve_stats
+
+        tagged = [r for r in rows if r["request_stats"].get("replica_id")]
+        ids = sorted({r["request_stats"]["replica_id"] for r in tagged})
+        if not tagged:
+            failures.append(
+                "--aggregate/--min-replicas requested but no record "
+                "carries a replica_id tag (single-engine ledger, or the "
+                "router never emitted stats?)"
+            )
+        else:
+            merged = serve_stats.merge_snapshots(
+                [r["request_stats"] for r in tagged])
+            qps = [r["router"]["qps"] for r in recs
+                   if isinstance(r.get("router"), dict)
+                   and isinstance(r["router"].get("qps"), (int, float))]
+            qps_note = (f" qps_sum={round(sum(qps), 3)}"
+                        f" (over {len(qps)} router block(s))" if qps else "")
+            print(
+                f"# aggregate[{len(tagged)} records, "
+                f"{len(ids)} replica(s) {ids}]: "
+                f"requests={merged['requests']} ok={merged['ok']} "
+                f"failed={merged['failed']} "
+                f"worst p99={merged['latency_ms']['p99']}ms "
+                f"cache hits={merged['cache']['hits']} "
+                f"misses={merged['cache']['misses']} "
+                f"hit_rate={merged['cache']['hit_rate']:.3f} "
+                f"compiles={merged['cache'].get('compiles', 0)}" + qps_note
+            )
+            for r in tagged:
+                rs = r["request_stats"]
+                print(
+                    f"#   replica {rs['replica_id']}: "
+                    f"requests={rs['requests']} batches={rs['batches']} "
+                    f"occupancy={rs['batch_occupancy_mean']} "
+                    f"p99={rs['latency_ms']['p99']}ms"
+                )
+            if (args.min_replicas is not None
+                    and len(ids) < args.min_replicas):
+                failures.append(
+                    f"{len(ids)} distinct replica tag(s) {ids} < "
+                    f"--min-replicas {args.min_replicas}"
+                )
+            if (args.min_hit_rate is not None
+                    and merged["cache"]["hit_rate"] < args.min_hit_rate):
+                failures.append(
+                    f"aggregate hit_rate {merged['cache']['hit_rate']:.3f} "
+                    f"< {args.min_hit_rate}"
+                )
     if args.max_queue_wait_ms is not None and not split_seen:
         failures.append(
             "--max-queue-wait-ms requested but no record carries a "
@@ -500,6 +558,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="gate the small-N bucket latency split separately: "
                         "fail when any record's latency_ms_small.p99 "
                         "exceeds this, or when no record carries the split")
+    s.add_argument("--aggregate", action="store_true",
+                   help="fold replica-tagged records through "
+                        "stats.merge_snapshots and report the fleet view "
+                        "(summed counts + router-block QPS, worst tail, "
+                        "per-replica occupancy); fails loudly when no "
+                        "record carries a replica_id tag")
+    s.add_argument("--min-replicas", type=int, default=None,
+                   help="fail unless the ledger carries at least this many "
+                        "distinct replica_id tags (the it-really-was-"
+                        "multi-replica gate for make serve-replicas)")
     s.set_defaults(fn=_serve_report)
 
     lr = sub.add_parser(

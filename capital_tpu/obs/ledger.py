@@ -298,6 +298,33 @@ def validate_request_stats(block) -> list[str]:
             probs.append(
                 f"requests_small must be a non-negative int, got {rs!r}"
             )
+    # multi-replica tags (serve/router.py, PR 9): a per-replica record
+    # carries replica_id; the router's aggregate record carries replicas
+    # (how many snapshots merged) and replica_ids.  Single-engine records
+    # carry none of them and stay valid unchanged.
+    if "replica_id" in block and not isinstance(block["replica_id"], str):
+        probs.append(
+            f"replica_id must be a string, got {block['replica_id']!r}"
+        )
+    if "replicas" in block:
+        n = block["replicas"]
+        if not isinstance(n, int) or isinstance(n, bool) or n < 1:
+            probs.append(f"replicas must be a positive int, got {n!r}")
+    if "replica_ids" in block:
+        ids = block["replica_ids"]
+        if (not isinstance(ids, list)
+                or not all(isinstance(i, str) for i in ids)):
+            probs.append(
+                f"replica_ids must be a list of strings, got {ids!r}"
+            )
+    if "samples" in block:
+        # raw latency populations (Collector.snapshot(samples=True)) are a
+        # router-internal pooling vehicle; a ledger record carrying them
+        # is a producer bug (unbounded growth), so flag rather than allow
+        probs.append(
+            "samples block present — raw populations are for in-memory "
+            "aggregation (stats.merge_snapshots), strip before append"
+        )
     return probs
 
 

@@ -15,17 +15,67 @@ The engine is a facade over three pieces (PR 7): `scheduler.py`
 ``ServeConfig.persist_dir``), and `executor.py` (dispatch, donation, fault
 containment, landing).  `loadgen.py` is the closed-loop A/B + SLO harness.
 
+Multi-replica (PR 9): `router.py` is the client-facing front end over N
+`replica.py` workers — in-process threads or spawned engine processes —
+sharing one persistent cache directory, with pluggable dispatch
+(least_loaded / bucket_affinity), per-replica health + re-dispatch, and a
+drain lifecycle for rolling restarts.
+
+    r = serve.Router(serve.RouterConfig(policy="bucket_affinity"))
+    for i in range(2):
+        r.add_replica(serve.make_replica("process", f"r{i}", cfg))
+    r.warmup(specs); r.start()
+    x = r.submit("posv", A, B).result(timeout=60)
+
+Exports resolve lazily (PEP 562): the engine names pull in jax on first
+touch, while Router/replica/loadgen stay importable from host-only
+processes (router pumps, loadgen clients) that must never pay — or
+crash on — a device runtime import.
+
 Smoke workload + gates: ``python -m capital_tpu.serve smoke`` /
 ``make serve-smoke``; A/B throughput: ``python -m capital_tpu.serve
-loadgen`` / ``make serve-bench``.
+loadgen`` / ``make serve-bench``; multi-replica: ``python -m
+capital_tpu.serve replicas`` / ``make serve-replicas``.
 """
 
-from capital_tpu.serve.cache import ExecutableCache  # noqa: F401
-from capital_tpu.serve.engine import (  # noqa: F401
-    Response,
-    ServeConfig,
-    SolveEngine,
-    Ticket,
-)
-from capital_tpu.serve.executor import Executor  # noqa: F401
-from capital_tpu.serve.scheduler import Scheduler  # noqa: F401
+from __future__ import annotations
+
+#: attribute -> defining submodule; the engine-side names import jax
+#: transitively, the router side stays host-only.
+_EXPORTS = {
+    "ExecutableCache": "capital_tpu.serve.cache",
+    "Response": "capital_tpu.serve.engine",
+    "ServeConfig": "capital_tpu.serve.engine",
+    "SolveEngine": "capital_tpu.serve.engine",
+    "Ticket": "capital_tpu.serve.engine",
+    "Executor": "capital_tpu.serve.executor",
+    "Scheduler": "capital_tpu.serve.scheduler",
+    "EngineReplica": "capital_tpu.serve.replica",
+    "ProcessReplica": "capital_tpu.serve.replica",
+    "Result": "capital_tpu.serve.replica",
+    "ThreadReplica": "capital_tpu.serve.replica",
+    "make_replica": "capital_tpu.serve.replica",
+    "Router": "capital_tpu.serve.router",
+    "RouterConfig": "capital_tpu.serve.router",
+    "RouterTicket": "capital_tpu.serve.router",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    try:
+        module = _EXPORTS[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        ) from None
+    import importlib
+
+    value = getattr(importlib.import_module(module), name)
+    globals()[name] = value  # cache: next access skips __getattr__
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

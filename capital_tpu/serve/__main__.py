@@ -24,6 +24,14 @@ with zero fresh XLA compiles — every executable deserializes from disk.
 stop-and-go) and continuous schedulers, one serve:request_stats record
 per mode with the queue-wait/device split and the QPS comparison —
 `make serve-bench` gates those records via ``obs serve-report``.
+
+``python -m capital_tpu.serve replicas`` is the multi-replica smoke
+(serve/router.py): N replicas behind one Router sharing a persistent AOT
+cache directory, with an induced kill (in-flight re-dispatch, replacement
+warmed from disk) and an induced drain + resume, gated on zero dropped
+requests and zero steady-state recompiles — `make serve-replicas` runs
+the cold/warm pair and aggregates with ``obs serve-report --aggregate``.
+The ``loadgen --replicas N`` variant is the replica-count scaling A/B.
 """
 
 from __future__ import annotations
@@ -185,7 +193,221 @@ def _smoke(args) -> int:
     return 0
 
 
+def _replicas(args) -> int:
+    """Multi-replica router smoke (docs/SERVING.md "Multi-replica
+    serving"): N replicas behind one Router sharing --persist-dir, the
+    loadgen workload submitted through the router with an optional induced
+    replica KILL (re-dispatch proof) and an induced DRAIN + resume
+    (rolling-restart proof) mid-stream.  Gates: every submitted request
+    lands ok under the residual tolerance (zero drops), aggregate
+    steady-state cache misses == 0, and with --max-compiles the summed
+    fresh-compile count across live replicas (the warm shared-dir run pins
+    it at 0 — replicas and the mid-stream replacement all deserialize)."""
+    import numpy as np
+
+    from capital_tpu.bench.drivers import _tolerance
+    from capital_tpu.serve import loadgen
+    from capital_tpu.serve.engine import ServeConfig
+    from capital_tpu.serve.replica import make_replica
+    from capital_tpu.serve.router import Router, RouterConfig
+
+    cfg = ServeConfig(
+        buckets=(16, 32, 64),
+        nrhs_buckets=(1, 4),
+        max_batch=4,
+        max_delay_s=0.002,
+        small_n_impl=args.small_n_impl,
+        persist_dir=args.persist_dir,
+    )
+    wl = loadgen.Workload(
+        requests=args.requests, concurrency=args.concurrency,
+        seed=args.seed, dtype=args.dtype,
+    )
+    work = loadgen.build_requests(wl)
+    specs = loadgen.warmup_specs(wl)
+    router = Router(RouterConfig(policy=args.policy))
+    for i in range(args.replicas):
+        router.add_replica(make_replica(args.replica_mode, f"r{i}", cfg))
+    fresh = router.warmup(specs)
+    print(f"# serve-replicas: warmup fresh compiles {fresh}")
+    router.start()
+
+    failures = []
+    tickets = []
+    kill_at = len(work) // 2 if args.kill_one else None
+    drain_at = (3 * len(work)) // 4 if args.drain_one else None
+    drained_id = None
+    t_start = time.monotonic()
+    for i, (op, A, B) in enumerate(work):
+        tickets.append((op, A, B, router.submit(op, A, B)))
+        if i == kill_at:
+            # abrupt death with a window full of in-flight requests: the
+            # pump must observe it and re-dispatch, and the replacement
+            # must warm from the SHARED disk tier, not recompile
+            router.kill_replica("r0")
+            rep = make_replica(args.replica_mode, f"r{args.replicas}", cfg)
+            router.add_replica(rep)
+            rep_fresh = router.warmup(specs)
+            print(f"# serve-replicas: killed r0, replacement "
+                  f"r{args.replicas} warmup fresh {rep_fresh}")
+            if sum(v or 0 for v in rep_fresh.values()):
+                failures.append(
+                    f"replacement replica recompiled {rep_fresh} — shared "
+                    "persist_dir should have made it a disk hit"
+                )
+        if i == drain_at:
+            live = router.replica_ids(healthy_only=True)
+            drained_id = live[-1]
+            ok = router.drain_replica(drained_id)
+            if not ok:
+                failures.append(f"drain_replica({drained_id!r}) timed out")
+            per = router.counters()["per_replica"][drained_id]
+            if per["outstanding"]:
+                failures.append(
+                    f"drained replica {drained_id} still has "
+                    f"{per['outstanding']} outstanding"
+                )
+            print(f"# serve-replicas: drained {drained_id} under load "
+                  f"(outstanding now {per['outstanding']})")
+
+    tol = _tolerance(np.dtype(args.dtype))
+    worst: dict[str, float] = {}
+    landed = 0
+    for op, A, B, t in tickets:
+        r = t.result(timeout=300.0)
+        landed += 1
+        if not r.ok or r.x is None:
+            failures.append(
+                f"request {r.request_id} ({op}) failed: {r.error}")
+            continue
+        res = _residual(op, A, B, r.x)
+        worst[op] = max(worst.get(op, 0.0), res)
+        gate = 10 * tol if op == "lstsq" else tol
+        if res >= gate:
+            failures.append(
+                f"request {r.request_id} ({op} {A.shape}) residual "
+                f"{res:.3e} >= {gate:.0e}"
+            )
+    wall = time.monotonic() - t_start
+    if drained_id is not None:
+        router.resume_replica(drained_id)
+    counters = router.counters()
+    qps = round(landed / wall, 3) if wall > 0 else 0.0
+    recs = router.emit_stats(args.ledger, router={
+        "qps": qps, "wall_s": round(wall, 6),
+        "kill_one": bool(args.kill_one), "drain_one": bool(args.drain_one),
+    })
+    agg = recs[-1]["request_stats"] if recs else {}
+    cache = agg.get("cache", {})
+    print(json.dumps(agg))
+    for op, v in sorted(worst.items()):
+        print(f"# serve-replicas: max {op} residual {v:.3e}")
+
+    if landed != len(work) or counters["completed"] != len(work):
+        failures.append(
+            f"dropped requests: {landed}/{len(work)} landed, counters "
+            f"{counters}"
+        )
+    if counters["parked"]:
+        failures.append(f"{counters['parked']} requests left parked")
+    if args.kill_one and not counters["failed_replicas"]:
+        failures.append("induced kill not observed (failed_replicas == 0)")
+    if cache.get("misses"):
+        failures.append(
+            f"steady-state recompile: aggregate cache {cache} (expected "
+            "misses == 0 after warmup)"
+        )
+    if (args.max_compiles is not None
+            and cache.get("compiles", 0) > args.max_compiles):
+        failures.append(
+            f"cold-start gate: {cache.get('compiles')} fresh XLA compiles "
+            f"across live replicas > --max-compiles {args.max_compiles} "
+            f"(disk tier: {cache.get('disk')})"
+        )
+    router.stop()
+    for f in failures:
+        print(f"# serve-replicas FAIL: {f}", file=sys.stderr)
+    if failures:
+        return 1
+    print(
+        f"# serve-replicas OK: {landed} requests over "
+        f"{counters['replicas']} replicas ({args.policy}) in {wall:.3f}s = "
+        f"{qps:.1f} qps; redispatched {counters['redispatched']}, "
+        f"duplicates {counters['duplicates']}, hit_rate "
+        f"{cache.get('hit_rate', 0):.2f}, {cache.get('compiles', 0)} "
+        "fresh compiles"
+    )
+    return 0
+
+
+def _loadgen_replicas(args) -> int:
+    """The replica-count A/B (loadgen.compare_replicas): equal per-client
+    offered load against 1 and --replicas replicas sharing --persist-dir;
+    the ledger's aggregate record per count carries the `router` block
+    with baseline_qps and scaling_efficiency."""
+    from capital_tpu.serve import loadgen
+    from capital_tpu.serve.engine import ServeConfig
+
+    cfg = ServeConfig(
+        buckets=(16, 32, 64),
+        nrhs_buckets=(1, 4),
+        max_batch=4,
+        max_delay_s=0.002,
+        small_n_impl=args.small_n_impl,
+        max_inflight=args.max_inflight,
+        persist_dir=args.persist_dir,
+    )
+    wl = loadgen.Workload(
+        requests=args.requests, concurrency=args.concurrency,
+        seed=args.seed, dtype=args.dtype,
+    )
+    counts = (1, args.replicas) if args.replicas > 1 else (1,)
+    results = loadgen.compare_replicas(
+        cfg, wl, replica_counts=counts, replica_mode=args.replica_mode,
+        client_mode=args.client_mode, policy=args.policy,
+        ledger_path=args.ledger,
+    )
+    failures = []
+    for n in counts:
+        res = results[n]
+        agg = res["records"][-1]["request_stats"]
+        cache = agg.get("cache", {})
+        print(
+            f"# serve-loadgen replicas={n}: {res['requests']} requests, "
+            f"{res['clients']} {res['client_mode']} clients in "
+            f"{res['wall_s']:.3f}s = {res['qps']:.1f} qps (aggregate "
+            f"misses {cache.get('misses')}, compiles {cache.get('compiles')})"
+        )
+        if res["failed"]:
+            failures.append(f"replicas={n}: {res['failed']} requests failed")
+        if cache.get("misses"):
+            failures.append(
+                f"replicas={n}: {cache['misses']} steady-state recompiles"
+            )
+    eff = results.get("scaling_efficiency")
+    if eff is not None:
+        print(
+            f"# serve-loadgen: {counts[-1]}-replica speedup "
+            f"{results['speedup']:.2f}x, scaling efficiency {eff:.2f} "
+            f"(1.0 = each replica pulls full single-replica weight)"
+        )
+        if args.min_scaling is not None and eff < args.min_scaling:
+            failures.append(
+                f"scaling efficiency {eff:.2f} < --min-scaling "
+                f"{args.min_scaling}"
+            )
+    for f in failures:
+        print(f"# serve-loadgen FAIL: {f}", file=sys.stderr)
+    if failures:
+        return 1
+    print("# serve-loadgen OK")
+    return 0
+
+
 def _loadgen(args) -> int:
+    if args.replicas:
+        return _loadgen_replicas(args)
+
     from capital_tpu.serve import loadgen
     from capital_tpu.serve.engine import ServeConfig
 
@@ -283,7 +505,64 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--min-speedup", type=float, default=None,
                    help="fail if continuous/sync QPS falls below this "
                         "(leave unset on shared CI hardware)")
+    g.add_argument("--replicas", type=int, default=0,
+                   help="run the replica-count A/B instead: 1 vs N "
+                        "replicas behind a router at equal per-client "
+                        "offered load (loadgen.compare_replicas)")
+    g.add_argument("--replica-mode", default="thread",
+                   choices=("thread", "process"),
+                   help="replica transport: in-process threads (CI) or "
+                        "spawned engine processes")
+    g.add_argument("--client-mode", default="thread",
+                   choices=("thread", "process"),
+                   help="closed-loop client transport for the router A/B")
+    g.add_argument("--policy", default="least_loaded",
+                   help="router dispatch policy (least_loaded or "
+                        "bucket_affinity)")
+    g.add_argument("--min-scaling", type=float, default=None,
+                   help="fail if N-replica scaling efficiency falls below "
+                        "this (leave unset on shared CI hardware — this "
+                        "rig may have fewer cores than replicas)")
     g.set_defaults(fn=_loadgen)
+    r = sub.add_parser(
+        "replicas",
+        help="multi-replica router smoke: shared persistent cache, "
+             "induced kill + drain, zero-drop and recompile gates",
+    )
+    r.add_argument("--replicas", type=int, default=2)
+    r.add_argument("--requests", type=int, default=48)
+    r.add_argument("--concurrency", type=int, default=8,
+                   help="recorded in the workload (submission here is "
+                        "paced by the router, not a client pool)")
+    r.add_argument("--seed", type=int, default=0)
+    r.add_argument("--dtype", default="float32")
+    r.add_argument("--ledger", default=None,
+                   help="append per-replica + aggregate request_stats "
+                        "records here")
+    r.add_argument("--platform", default=None)
+    r.add_argument("--small-n-impl", default="pallas",
+                   choices=("auto", "vmap", "pallas", "pallas_split"),
+                   help="pallas (interpret on CPU) keeps every executable "
+                        "pure-HLO and therefore disk-persistable — the "
+                        "shared-cache story this smoke proves")
+    r.add_argument("--replica-mode", default="thread",
+                   choices=("thread", "process"))
+    r.add_argument("--policy", default="bucket_affinity",
+                   help="router dispatch policy; bucket_affinity is the "
+                        "cache-locality default here so the kill also "
+                        "proves the rebalance-is-a-disk-hit property")
+    r.add_argument("--persist-dir", default=None,
+                   help="shared persistent AOT cache directory")
+    r.add_argument("--kill-one", action="store_true",
+                   help="kill replica r0 mid-stream and register a "
+                        "replacement (re-dispatch + disk-warm proof)")
+    r.add_argument("--drain-one", action="store_true",
+                   help="drain one replica under load, then resume it "
+                        "(rolling-restart proof)")
+    r.add_argument("--max-compiles", type=int, default=None,
+                   help="fail if live replicas' summed fresh XLA compiles "
+                        "exceed this (0 on a warm shared --persist-dir)")
+    r.set_defaults(fn=_replicas)
     return p
 
 

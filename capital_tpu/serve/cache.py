@@ -30,10 +30,12 @@ versions).  Every disk failure mode degrades to *compile-and-overwrite*:
   ``disk_skips``, memory-only (`persistable_program`).
 
 Writes are atomic (`os.replace` of a uniquely-named temp file), so two
-engines sharing a cache directory race benignly: the loser's entry simply
-overwrites the winner's byte-identical one, and a reader never observes a
-half-written file.  Nothing in this module raises to the caller for a disk
-reason.
+engines sharing a cache directory race benignly: a reader never observes a
+half-written file, and a writer that finds a valid same-fingerprint entry
+already present (the multi-replica warmup pattern — every cold replica
+compiles the same first-touch programs) skips the redundant write and
+counts it in ``disk_races``, keeping ``disk_errors`` a real-failure
+signal.  Nothing in this module raises to the caller for a disk reason.
 """
 
 from __future__ import annotations
@@ -120,6 +122,12 @@ class ExecutableCache:
         self.disk_misses = 0
         self.disk_errors = 0
         self.disk_skips = 0  # programs persistable_program() kept off disk
+        # benign lost writer races: another engine sharing the dir already
+        # stored a valid entry for this exact (key, fingerprint) — the
+        # write is redundant, not broken.  Counted apart from disk_errors
+        # so N replicas warming one shared dir don't read as N-1 disk
+        # failures and the --max-compiles 0 warm gate stays meaningful.
+        self.disk_races = 0
 
     def __len__(self) -> int:
         return len(self._mem)
@@ -177,6 +185,7 @@ class ExecutableCache:
                 "misses": self.disk_misses,
                 "errors": self.disk_errors,
                 "skips": self.disk_skips,
+                "races": self.disk_races,
             }
         return out
 
@@ -221,13 +230,35 @@ class ExecutableCache:
             self.disk_errors += 1
             return None
 
+    def _peek_valid(self, key: tuple) -> bool:
+        """Whether a valid entry for (key, fingerprint) already sits on
+        disk — metadata check only (no PJRT deserialization), used to tell
+        a benign lost race from a real store failure.  False on ANY doubt:
+        a wrong answer here only misfiles one counter."""
+        try:
+            with open(self.entry_path(key), "rb") as f:
+                entry = pickle.load(f)
+            return (entry.get("fingerprint") == self._fp
+                    and entry.get("key") == repr(key)
+                    and entry.get("payload") is not None)
+        except Exception:  # lint: allow-broad-except — absent/corrupt/
+            # unreadable all mean "no valid entry", which is the answer
+            return False
+
     def _store(self, key: tuple, exe) -> None:
         """Spill one compiled executable; atomic via temp-file + replace so
-        concurrent writers sharing the dir never expose torn entries."""
+        concurrent writers sharing the dir never expose torn entries.  A
+        writer that LOST the race (a valid same-fingerprint entry is
+        already there — N replicas warming one shared dir all compile the
+        same first-touch programs) skips the redundant write and counts
+        ``disk_races``, not ``disk_errors``."""
         from jax.experimental import serialize_executable
 
         if not persistable_program(exe):
             self.disk_skips += 1
+            return
+        if self._peek_valid(key):
+            self.disk_races += 1
             return
         try:
             payload, in_tree, out_tree = serialize_executable.serialize(exe)
@@ -246,6 +277,12 @@ class ExecutableCache:
             os.replace(tmp, path)
         except Exception as e:  # noqa: BLE001 — a store failure costs the
             # NEXT process a compile, never this one a crash; log + count.
+            if self._peek_valid(key):
+                # lost the race mid-write (e.g. tmp replace under a
+                # concurrent writer): a valid entry is there, so the next
+                # cold start is still covered — benign, not an error
+                self.disk_races += 1
+                return
             _log.warning("persistent cache store for %r failed (%s: %s); "
                          "entry serves from memory only", key,
                          type(e).__name__, e)

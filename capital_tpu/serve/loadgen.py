@@ -21,8 +21,19 @@ alone (`make serve-bench`):
 * **zero steady-state recompiles** — each record's cache block
   (`misses == 0`, `hit_rate == 1.0` after warmup).
 
-Everything here is host-side policy around `SolveEngine`'s public surface
-(submit/pump/drain) — no jax in this module beyond what the engine does.
+Multi-replica (PR 9): `run_router_closed_loop` drives a serve.router
+Router with M concurrent closed-loop clients — threads, or separate
+client PROCESSES relaying submits over pipes (offered load that does not
+share the router's GIL) — and `compare_replicas` is the replica-count A/B:
+the same per-client offered load against 1 and N replicas sharing one
+persist_dir, one aggregate record per count carrying a `router` block with
+``baseline_qps`` and ``scaling_efficiency = (qps_N / N) / (qps_1 / 1)`` —
+the honest scaling number (raw speedup flatters N replicas on any rig;
+efficiency reads 1.0 only when each replica pulls its weight).
+
+Everything here is host-side policy around the engine/router public
+surfaces (submit/pump/drain) — the engine import is lazy so a spawned
+client process never imports jax at all.
 """
 
 from __future__ import annotations
@@ -32,8 +43,6 @@ import time
 from typing import Optional
 
 import numpy as np
-
-from capital_tpu.serve.engine import ServeConfig, SolveEngine
 
 
 @dataclasses.dataclass(frozen=True)
@@ -91,7 +100,7 @@ def warmup_specs(wl: Workload) -> list[tuple]:
     return specs
 
 
-def run_closed_loop(eng: SolveEngine, requests: list[tuple],
+def run_closed_loop(eng, requests: list[tuple],
                     concurrency: int) -> dict:
     """Drive one engine to completion over `requests` with at most
     `concurrency` clients outstanding.  A client's slot frees when its
@@ -141,7 +150,9 @@ def run_closed_loop(eng: SolveEngine, requests: list[tuple],
     }
 
 
-def _mk_engine(cfg: ServeConfig, scheduler: str, grid=None) -> SolveEngine:
+def _mk_engine(cfg, scheduler: str, grid=None):
+    from capital_tpu.serve.engine import SolveEngine
+
     return SolveEngine(grid, dataclasses.replace(cfg, scheduler=scheduler))
 
 
@@ -185,4 +196,238 @@ def compare(cfg: ServeConfig, wl: Workload = Workload(), *, grid=None,
             block["baseline_qps"] = results["sync"]["qps"]
             block["speedup"] = speedup
         res["record"] = eng.emit_stats(ledger_path, loadgen=block)
+    return results
+
+
+# ---- multi-replica offered load (PR 9; docs/SERVING.md) -------------------
+
+
+def _client_requests(wl: Workload, client: int, clients: int) -> list[tuple]:
+    """Client `client`'s slice of the workload: one shared fixed-seed list,
+    dealt round-robin — every client sees the same op/bucket mix, and the
+    union across clients is byte-identical for every (replica count,
+    client mode) being compared."""
+    return build_requests(wl)[client::clients]
+
+
+def _client_loop(submit, requests: list[tuple]) -> dict:
+    """One closed-loop client: exactly one request in flight.  `submit` is
+    op, A, B -> (ok, error); counts come back to the caller."""
+    ok = failed = 0
+    for op, A, B in requests:
+        good, _err = submit(op, A, B)
+        ok += 1 if good else 0
+        failed += 0 if good else 1
+    return {"requests": len(requests), "ok": ok, "failed": failed}
+
+
+def _client_proc_main(conn, wl: Workload, client: int, clients: int) -> None:
+    """Child main for one PROCESS client (spawn target — top level, and
+    this module imports no jax, so the client process stays lightweight).
+    Speaks ("submit", seq, op, A, B) / receives ("result", seq, ok, error);
+    strictly one in flight — the closed loop lives HERE, in the client."""
+    reqs = _client_requests(wl, client, clients)
+    seq = 0
+
+    def submit(op, A, B):
+        nonlocal seq
+        conn.send(("submit", seq, op, A, B))
+        kind, rseq, good, err = conn.recv()
+        assert kind == "result" and rseq == seq, (kind, rseq, seq)
+        seq += 1
+        return good, err
+
+    counts = _client_loop(submit, reqs)
+    conn.send(("done", counts))
+    conn.close()
+
+
+def _run_thread_clients(router, wl: Workload, clients: int,
+                        timeout: float) -> list[dict]:
+    import threading
+
+    out: list[Optional[dict]] = [None] * clients
+
+    def client(ci: int) -> None:
+        def submit(op, A, B):
+            t = router.submit(op, A, B)
+            res = t.result(timeout)
+            return res.ok, res.error
+
+        out[ci] = _client_loop(submit, _client_requests(wl, ci, clients))
+
+    threads = [threading.Thread(target=client, args=(ci,), daemon=True)
+               for ci in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout)
+        if t.is_alive():
+            raise TimeoutError(f"loadgen client thread {t.name} wedged")
+    return [c for c in out if c is not None]
+
+
+def _run_process_clients(router, wl: Workload, clients: int,
+                         timeout: float) -> list[dict]:
+    """M client processes against the in-process router: each child runs
+    its own closed loop over a pipe; this frontend relays submits to the
+    router and landed results back.  The router's pump thread is already
+    running — this loop only moves messages."""
+    import multiprocessing as mp
+
+    ctx = mp.get_context("spawn")
+    conns, procs = [], []
+    for ci in range(clients):
+        parent, child = ctx.Pipe(duplex=True)
+        p = ctx.Process(target=_client_proc_main,
+                        args=(child, wl, ci, clients), daemon=True)
+        p.start()
+        child.close()
+        conns.append(parent)
+        procs.append(p)
+    pending: dict[tuple[int, int], object] = {}
+    counts: dict[int, dict] = {}
+    deadline = time.monotonic() + timeout
+    try:
+        while len(counts) < clients:
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"process-client loadgen incomplete: {len(counts)}/"
+                    f"{clients} clients done, {len(pending)} in flight"
+                )
+            progressed = False
+            for ci, conn in enumerate(conns):
+                if ci in counts:
+                    continue
+                while conn.poll(0):
+                    try:
+                        msg = conn.recv()
+                    except EOFError:
+                        raise RuntimeError(
+                            f"loadgen client process {ci} died mid-run"
+                        ) from None
+                    if msg[0] == "submit":
+                        _, seq, op, A, B = msg
+                        pending[(ci, seq)] = router.submit(op, A, B)
+                        progressed = True
+                    else:  # ("done", counts) — stop polling this pipe;
+                        # the child closes its end next and poll() would
+                        # report the EOF as readable forever
+                        counts[ci] = msg[1]
+                        progressed = True
+                        break
+            for key, t in list(pending.items()):
+                if t.done:
+                    ci, seq = key
+                    res = t.response
+                    conns[ci].send(("result", seq, res.ok, res.error))
+                    del pending[key]
+                    progressed = True
+            if not progressed:
+                time.sleep(1e-3)
+    finally:
+        for p in procs:
+            p.join(5.0)
+            if p.is_alive():
+                p.kill()
+    return [counts[ci] for ci in sorted(counts)]
+
+
+def run_router_closed_loop(router, wl: Workload, clients: int, *,
+                           client_mode: str = "thread",
+                           timeout: float = 600.0) -> dict:
+    """Drive one Router to completion with `clients` closed-loop clients
+    (each holds exactly one request in flight — offered load is `clients`
+    outstanding).  `client_mode="process"` puts each client in its own
+    spawned process (loads the router through real IPC and leaves the GIL
+    to the router+replicas).  The router must have its replicas registered
+    and warmed; its pump thread is started (and left running) here."""
+    if client_mode not in ("thread", "process"):
+        raise ValueError(f"unknown client_mode {client_mode!r}")
+    router.start()
+    t_start = time.monotonic()
+    runner = (_run_thread_clients if client_mode == "thread"
+              else _run_process_clients)
+    per_client = runner(router, wl, clients, timeout)
+    wall = time.monotonic() - t_start
+    completed = sum(c["requests"] for c in per_client)
+    return {
+        "requests": completed,
+        "ok": sum(c["ok"] for c in per_client),
+        "failed": sum(c["failed"] for c in per_client),
+        "clients": clients,
+        "client_mode": client_mode,
+        "wall_s": round(wall, 6),
+        "qps": round(completed / wall, 3) if wall > 0 else 0.0,
+    }
+
+
+def compare_replicas(
+    cfg, wl: Workload = Workload(), *,
+    replica_counts: tuple[int, ...] = (1, 2),
+    replica_mode: str = "thread",
+    client_mode: str = "thread",
+    policy: str = "least_loaded",
+    ledger_path: Optional[str] = None,
+    env: Optional[dict] = None,
+) -> dict:
+    """The replica-count A/B: the same fixed-seed workload at EQUAL
+    per-client offered load (clients and total requests both scale with
+    the replica count, so each client's closed loop is identical across
+    counts) against a fresh router per count, all counts sharing
+    ``cfg.persist_dir`` — count 1 warms the disk tier, every later count
+    proves the multi-writer warm path.
+
+    Emits per-replica records plus one aggregate record per count; the
+    aggregate's `router` block carries qps, and — for counts past the
+    first — ``baseline_qps`` (the first count's) and
+    ``scaling_efficiency = (qps_N / N) / (qps_base / base)``.  Returns
+    {count: results, 'scaling_efficiency': ..., 'speedup': ...}."""
+    from capital_tpu.serve.replica import make_replica
+    from capital_tpu.serve.router import Router, RouterConfig
+
+    specs = warmup_specs(wl)
+    results: dict = {}
+    base_n = replica_counts[0]
+    for n in replica_counts:
+        wl_n = dataclasses.replace(wl, requests=wl.requests * n)
+        clients = wl.concurrency * n
+        router = Router(RouterConfig(policy=policy))
+        for i in range(n):
+            router.add_replica(make_replica(
+                replica_mode, f"n{n}-r{i}", cfg, env=env))
+        warm = router.warmup(specs)
+        try:
+            res = run_router_closed_loop(
+                router, wl_n, clients, client_mode=client_mode)
+            res["warmup_fresh"] = warm
+            res["counters"] = router.counters()
+            block = {
+                "replicas": n,
+                "policy": policy,
+                "replica_mode": replica_mode,
+                "client_mode": client_mode,
+                "clients": clients,
+                "seed": wl.seed,
+                "qps": res["qps"],
+                "wall_s": res["wall_s"],
+            }
+            if n != base_n and base_n in results:
+                base_qps = results[base_n]["qps"]
+                block["baseline_qps"] = base_qps
+                block["baseline_replicas"] = base_n
+                if base_qps:
+                    block["speedup"] = round(res["qps"] / base_qps, 4)
+                    block["scaling_efficiency"] = round(
+                        (res["qps"] / n) / (base_qps / base_n), 4)
+            res["router_block"] = block
+            res["records"] = router.emit_stats(ledger_path, router=block)
+            results[n] = res
+        finally:
+            router.stop()
+    counts = [n for n in replica_counts if n in results]
+    if len(counts) >= 2:
+        last = results[counts[-1]]["router_block"]
+        results["speedup"] = last.get("speedup")
+        results["scaling_efficiency"] = last.get("scaling_efficiency")
     return results

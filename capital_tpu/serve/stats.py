@@ -24,7 +24,13 @@ class Collector:
     """Accumulates serving telemetry; snapshot() emits the request_stats
     block documented in docs/SERVING.md."""
 
-    def __init__(self):
+    def __init__(self, replica_id: str | None = None):
+        # multi-replica deployments tag each collector with its replica's
+        # id so the router / `obs serve-report --aggregate` can tell the
+        # per-replica records apart (docs/SERVING.md "Multi-replica
+        # serving"); None (the single-engine default) keeps the snapshot
+        # schema exactly what it always was.
+        self.replica_id = replica_id
         self.requests = 0
         self.ok = 0
         self.flagged = 0  # robust-flagged (breakdown detected, result kept)
@@ -80,10 +86,14 @@ class Collector:
 
     # ---- reporting ---------------------------------------------------------
 
-    def snapshot(self, cache: dict | None = None) -> dict:
+    def snapshot(self, cache: dict | None = None, *,
+                 samples: bool = False) -> dict:
         """The request_stats block.  `cache` is the engine's cache_stats()
         (hits/misses/hit_rate/warmup_compiles); zeros when absent so the
-        schema stays total."""
+        schema stays total.  `samples=True` attaches the raw latency
+        populations (seconds) so merge_snapshots can pool percentiles
+        exactly instead of max-of-p99 — meant for router-internal
+        aggregation, not for ledger records (strip it before append)."""
         from capital_tpu.obs.ledger import SCHEMA_VERSION
 
         lat = (
@@ -135,6 +145,15 @@ class Collector:
                 k: round(v * 1e3, 4)
                 for k, v in percentiles(self.devices_s).items()
             }
+        if self.replica_id is not None:
+            snap["replica_id"] = str(self.replica_id)
+        if samples:
+            snap["samples"] = {
+                "latency_s": list(self.latencies_s),
+                "latency_small_s": list(self.latencies_small_s),
+                "queue_wait_s": list(self.queue_waits_s),
+                "device_s": list(self.devices_s),
+            }
         return snap
 
     def emit(self, path: str | None, *, grid=None, config=None,
@@ -153,3 +172,111 @@ class Collector:
         if path:
             ledger.append(path, rec)
         return rec
+
+
+# ---- cross-replica aggregation (pure; docs/SERVING.md) --------------------
+
+#: percentile block -> the samples-block population it pools from.
+_SAMPLE_KEYS = {
+    "latency_ms": "latency_s",
+    "latency_ms_small": "latency_small_s",
+    "queue_wait_ms": "queue_wait_s",
+    "device_ms": "device_s",
+}
+
+
+def _merge_pcts(snaps: list[dict], name: str) -> dict | None:
+    """One merged percentile block across `snaps`.  Pools the raw sample
+    populations when EVERY contributing snapshot carries them (exact
+    percentiles of the union); otherwise the elementwise max — the honest
+    degraded answer, because a worst-tail bound is the only percentile
+    that survives aggregation without the populations."""
+    present = [s for s in snaps if name in s]
+    if name == "latency_ms":
+        present = snaps  # total block: every snapshot has it
+    if not present:
+        return None
+    skey = _SAMPLE_KEYS[name]
+    if all("samples" in s for s in present):
+        pool = [v for s in present for v in s["samples"].get(skey, ())]
+        if not pool:
+            return {"p50": 0.0, "p95": 0.0, "p99": 0.0}
+        return {k: round(v * 1e3, 4) for k, v in percentiles(pool).items()}
+    out = {"p50": 0.0, "p95": 0.0, "p99": 0.0}
+    for s in present:
+        blk = s.get(name) or {}
+        for p in out:
+            out[p] = max(out[p], float(blk.get(p, 0.0)))
+    return out
+
+
+def merge_snapshots(snaps: list[dict]) -> dict:
+    """Fold N replica-tagged request_stats snapshots into ONE aggregate
+    block (pure — unit-testable without a ledger or an engine):
+
+    * counts (requests/ok/flagged/failed/batches, per-op) sum; queue depth
+      takes the max (depths are per-replica queues, not one shared queue);
+    * occupancy is the batch-weighted mean — N half-full replicas must not
+      average into a healthy-looking number just because one was idle;
+    * percentiles pool from the raw sample populations when present
+      (Collector.snapshot(samples=True)), else take the worst tail
+      (elementwise max) — never a mean of percentiles, which is a number
+      with no definition;
+    * cache counters sum (incl. the disk tier when any replica persists)
+      with hit_rate recomputed from the summed lookups;
+    * the result carries ``replicas`` (how many snapshots merged) and
+      ``replica_ids``, drops per-replica tags/samples, and stays valid
+      under obs.ledger.validate_request_stats.
+    """
+    if not snaps:
+        raise ValueError("merge_snapshots needs at least one snapshot")
+    ops: Counter = Counter()
+    for s in snaps:
+        ops.update(s.get("ops") or {})
+    batches = sum(int(s.get("batches", 0)) for s in snaps)
+    occ_w = sum(float(s.get("batch_occupancy_mean", 0.0))
+                * int(s.get("batches", 0)) for s in snaps)
+    merged = {
+        "schema_version": snaps[0].get("schema_version"),
+        "requests": sum(int(s.get("requests", 0)) for s in snaps),
+        "ok": sum(int(s.get("ok", 0)) for s in snaps),
+        "flagged": sum(int(s.get("flagged", 0)) for s in snaps),
+        "failed": sum(int(s.get("failed", 0)) for s in snaps),
+        "ops": dict(ops),
+        "latency_ms": _merge_pcts(snaps, "latency_ms"),
+        "queue_depth_max": max(int(s.get("queue_depth_max", 0))
+                               for s in snaps),
+        "batches": batches,
+        "batch_occupancy_mean": (
+            round(occ_w / batches, 4) if batches else 0.0
+        ),
+        "replicas": len(snaps),
+    }
+    ids = [s["replica_id"] for s in snaps if s.get("replica_id")]
+    if ids:
+        merged["replica_ids"] = sorted(ids)
+    cache = {"hits": 0, "misses": 0, "warmup_compiles": 0, "compiles": 0,
+             "entries": 0}
+    disk: dict | None = None
+    for s in snaps:
+        c = s.get("cache") or {}
+        for k in cache:
+            cache[k] += int(c.get(k, 0))
+        d = c.get("disk")
+        if d:
+            disk = disk or {}
+            for k, v in d.items():
+                disk[k] = disk.get(k, 0) + int(v)
+    lookups = cache["hits"] + cache["misses"]
+    cache["hit_rate"] = (cache["hits"] / lookups) if lookups else 1.0
+    if disk is not None:
+        cache["disk"] = disk
+    merged["cache"] = cache
+    for name in ("latency_ms_small", "queue_wait_ms", "device_ms"):
+        blk = _merge_pcts(snaps, name)
+        if blk is not None:
+            merged[name] = blk
+    if any("requests_small" in s for s in snaps):
+        merged["requests_small"] = sum(int(s.get("requests_small", 0))
+                                       for s in snaps)
+    return merged

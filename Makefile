@@ -5,8 +5,9 @@
 
 PY ?= python
 
-.PHONY: all test benchmarking bench-explicit bench-small tune audit lint \
-	robust serve-smoke serve-bench serve-replicas native clean
+.PHONY: all test benchmarking bench-explicit bench-small bench-blocktri \
+	tune audit lint robust serve-smoke serve-bench serve-replicas native \
+	clean
 
 all: test
 
@@ -47,6 +48,24 @@ bench-small:
 		--nrhs 2 --dtype float32 --latency --calls 8 \
 		--small-impl pallas --validate --ledger bench_small.jsonl
 
+# block-tridiagonal fast-path gate (docs/PERF.md round 11): the flagship
+# (nblocks=64, b=128, f32) chain vs the SAME problems assembled dense at
+# n=8192, gated at >= 25x per-problem wall-clock speedup with factor AND
+# solve residuals held to the dense f32 tolerance — the structural
+# O(n·b³) vs O(n³) win measured, not asserted.  CPU rig: the driver
+# resolves 'auto' to the xla scan off-TPU (interpret-pallas would
+# measure the emulator, not the algorithm).  The second row pins the
+# --latency protocol + the bench:blocktri_latency ledger seam on a
+# small validated shape.
+bench-blocktri:
+	rm -f bench_blocktri.jsonl
+	$(PY) -m capital_tpu.bench blocktri --platform cpu --dtype float32 \
+		--nblocks 64 --block 128 --batch 1 --nrhs 1 --validate \
+		--min-speedup 25 --ledger bench_blocktri.jsonl
+	$(PY) -m capital_tpu.bench blocktri --platform cpu --dtype float32 \
+		--nblocks 8 --block 16 --batch 4 --nrhs 2 --latency --calls 8 \
+		--validate --ledger bench_blocktri.jsonl
+
 # model-vs-compiled drift gate on the flagship configs (docs/OBSERVABILITY.md);
 # compile-only — runs in CI without a TPU (exit non-zero on drift).  The
 # bench.trace step is the phase-attribution gate: it decomposes a real
@@ -56,7 +75,7 @@ bench-small:
 # through obs trace-report — the same double-entry discipline as lint.
 # The generous 0.995 bound absorbs CPU-interpret emulation; what it pins
 # is that attribution works end to end.
-audit: serve-smoke serve-bench serve-replicas lint
+audit: serve-smoke serve-bench serve-replicas bench-blocktri lint
 	$(PY) -m capital_tpu.obs audit cholinv --n 4096 --platform cpu
 	$(PY) -m capital_tpu.obs audit cacqr --m 16384 --n 512 --platform cpu
 	$(PY) -m capital_tpu.obs robust-gate --platform cpu
@@ -155,5 +174,6 @@ native:
 clean:
 	rm -rf autotune_out .pytest_cache bench_explicit.jsonl serve_smoke.jsonl \
 		lint_report.jsonl bench_small.jsonl serve_bench.jsonl serve_cache \
-		bench_trace.jsonl serve_replicas.jsonl serve_replicas_cache
+		bench_trace.jsonl serve_replicas.jsonl serve_replicas_cache \
+		bench_blocktri.jsonl
 	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -1,4 +1,5 @@
-"""CLI: python -m capital_tpu.autotune {cholinv,cacqr,trsm,small} [flags]."""
+"""CLI: python -m capital_tpu.autotune {cholinv,cacqr,trsm,small,blocktri}
+[flags]."""
 
 from __future__ import annotations
 
@@ -9,7 +10,8 @@ import jax
 
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(prog="capital_tpu.autotune")
-    p.add_argument("alg", choices=["cholinv", "cacqr", "trsm", "small"])
+    p.add_argument("alg", choices=["cholinv", "cacqr", "trsm", "small",
+                                   "blocktri"])
     p.add_argument("--n", type=int, default=4096)
     p.add_argument("--m", type=int, default=65536)
     p.add_argument("--dtype", default="bfloat16")
@@ -84,13 +86,28 @@ def main(argv=None) -> None:
     )
     p.add_argument(
         "--impls", nargs="+", default=None,
-        choices=["vmap", "pallas", "pallas_split"],
-        help="small: implementation axis (default all three)",
+        choices=["vmap", "pallas", "pallas_split", "xla"],
+        help="small: implementation axis (default all three; 'xla' is the "
+        "blocktri baseline impl, invalid for small)",
     )
     p.add_argument(
         "--blocks", type=int, nargs="+", default=None,
-        help="small: column-block unroll axis for the pallas impls "
-        "(0 = pick_block default)",
+        help="small/blocktri: column-block unroll axis for the pallas "
+        "impls (0 = pick_block default)",
+    )
+    p.add_argument(
+        "--nblocks", type=int, default=8,
+        help="blocktri: chain length (diagonal blocks per problem)",
+    )
+    p.add_argument(
+        "--block", type=int, default=32,
+        help="blocktri: block size b (each diagonal block is b x b)",
+    )
+    p.add_argument(
+        "--segs", type=int, nargs="+", default=None,
+        help="blocktri: scan-segment-length axis — chain blocks per "
+        "pallas_call (resolve_seg snaps each to a divisor of --nblocks; "
+        "default 1 4 8)",
     )
     p.add_argument(
         "--calls", type=int, default=32,
@@ -230,6 +247,9 @@ def main(argv=None) -> None:
                 )
         space = {}
         if args.impls:
+            if "xla" in args.impls:
+                p.error("--impls xla is the blocktri baseline impl, not a "
+                        "small axis (vmap/pallas/pallas_split)")
             space["impls"] = tuple(args.impls)
         if args.blocks:
             space["blocks"] = tuple(args.blocks)
@@ -259,6 +279,40 @@ def main(argv=None) -> None:
             )
             res.extend(rs)
         res.sort(key=lambda r: r.seconds)
+    elif args.alg == "blocktri":
+        # latency-mode sweep for ONE posv_blocktri bucket: impl x
+        # block-unroll x scan-segment-length at fixed occupancy
+        for flag, given in (
+            ("--grids", "grids" in space),
+            ("--splits", bool(args.splits)),
+            ("--policies", bool(args.policies)),
+            ("--tail-depths", bool(args.tail_depths)),
+            ("--top-k", args.top_k != 0),
+            ("--modes", bool(args.modes)),
+            ("--bc", bool(args.bc)),
+            ("--buckets", bool(args.buckets)),
+        ):
+            if given:
+                p.error(
+                    f"{flag} is not a blocktri sweep axis (impl x block x "
+                    "seg only)"
+                )
+        space = {}
+        if args.impls:
+            if any(i in ("vmap", "pallas_split") for i in args.impls):
+                p.error("blocktri impls are 'xla' and 'pallas' only")
+            space["impls"] = tuple(args.impls)
+        if args.blocks:
+            space["blocks"] = tuple(args.blocks)
+        if args.segs:
+            space["segs"] = tuple(args.segs)
+        grid = Grid.square(c=1, devices=dev[:1])
+        res = sweep.tune_blocktri(
+            grid, args.nblocks, args.block, batch=args.batch,
+            nrhs=args.nrhs, dtype=dtype, out_dir=args.out,
+            occupancy=args.occupancy, calls=args.calls,
+            checkpoint=args.resume, ledger=args.ledger, **space,
+        )
     else:
         grid = Grid.flat(devices=dev)
         res = sweep.tune_cacqr(grid, args.m, args.n if args.n < args.m else 512,

@@ -748,6 +748,113 @@ def tune_small(
     )
 
 
+def blocktri_space(
+    nblocks: int,
+    b: int,
+    B_rhs,
+    dtype,
+    impls: Iterable[str] = ("xla", "pallas"),
+    blocks: Iterable[int] = (0,),
+    segs: Iterable[int] = (1, 4, 8),
+):
+    """impl x block-unroll x scan-segment-length for the block-tridiagonal
+    chain (models/blocktri): the knobs that shape the scan-of-Pallas-blocks
+    executable — in-kernel column unroll (`block`, the batched_small knob)
+    and chain blocks per pallas_call (`seg`, launch amortization vs the
+    VMEM step envelope).  The xla scan ignores both knobs (it scans one
+    block per step through lax.linalg), so that impl contributes ONE
+    baseline config rather than a degenerate axis product.  `B_rhs` rides
+    as a closure so the swept operand stays the single packed A array
+    (batch, 2, nblocks, b, b) — A[:, 0] the diagonal blocks, A[:, 1] the
+    couplings, the serve bucket packing."""
+    from capital_tpu.models import blocktri
+    from capital_tpu.ops import batched_small
+
+    prec = None if jnp.dtype(dtype).itemsize < 4 else "highest"
+    for impl in impls:
+        if impl not in ("xla", "pallas"):
+            raise ValueError(
+                f"blocktri_space: impl must be 'xla' or 'pallas', got {impl!r}"
+            )
+        if impl == "xla":
+            def step(a):
+                return blocktri.posv(a[:, 0], a[:, 1], B_rhs,
+                                     precision=prec, impl="xla")
+
+            yield "xla", {"impl": "xla"}, step
+            continue
+        for blk in blocks:
+            blk_eff = blk or batched_small.pick_block(b)
+            for seg in segs:
+                seg_eff = blocktri.resolve_seg(nblocks, seg)
+
+                def step(a, blk=blk, seg=seg_eff):
+                    return blocktri.posv(
+                        a[:, 0], a[:, 1], B_rhs, block=blk, seg=seg,
+                        precision=prec, impl="pallas")
+
+                yield (
+                    f"pallas_b{blk_eff}_s{seg_eff}",
+                    {"impl": "pallas", "block": blk_eff, "seg": seg_eff},
+                    step,
+                )
+
+
+def tune_blocktri(
+    grid: Grid,
+    nblocks: int,
+    b: int,
+    batch: int = 8,
+    nrhs: int = 1,
+    dtype=jnp.float32,
+    out_dir: str = "autotune_out",
+    occupancy: float = 1.0,
+    calls: int = 32,
+    warmup: int = 3,
+    checkpoint: bool = False,
+    ledger: str | None = None,
+    **space,
+) -> list[SweepResult]:
+    """Latency-mode sweep for ONE posv_blocktri serve bucket: impl x
+    block-unroll x scan-segment-length measured by per-call p99 wall time
+    (latency_measure) at fixed batch occupancy — the same serving
+    objective as tune_small, on the chain op.  The operand batch carries
+    ``round(occupancy * batch)`` real SPD chains and identity-chain fill
+    (identity diagonal blocks, zero couplings, zero RHS — exactly
+    batching.fill_problem) for the tail."""
+    import numpy as np
+
+    if not 0.0 < occupancy <= 1.0:
+        raise ValueError(f"tune_blocktri: occupancy {occupancy} outside (0, 1]")
+    real = max(1, round(occupancy * batch))
+    rng = np.random.default_rng(4)
+    G = rng.standard_normal((batch, nblocks, b, b))
+    D = G @ G.transpose(0, 1, 3, 2) / b + 3.0 * np.eye(b)
+    C = 0.3 / np.sqrt(b) * rng.standard_normal((batch, nblocks, b, b))
+    C[:, 0] = 0.0
+    D[real:] = np.eye(b)
+    C[real:] = 0.0
+    B = rng.standard_normal((batch, nblocks, b, nrhs))
+    B[real:] = 0.0  # fill chains: zero RHS -> exact-zero solutions
+    A = jax.block_until_ready(jnp.asarray(np.stack([D, C], axis=1), dtype))
+    B = jax.block_until_ready(jnp.asarray(B, dtype))
+    return run_sweep(
+        "blocktri",
+        blocktri_space(nblocks, b, B, dtype, **space),
+        A,
+        out_dir,
+        dtype=dtype,
+        checkpoint=checkpoint,
+        key_extra={
+            **_grid_key(grid), "op": "posv_blocktri", "nblocks": nblocks,
+            "b": b, "batch": batch, "nrhs": nrhs, "occupancy": occupancy,
+            "calls": calls,
+        },
+        ledger=ledger,
+        measure=latency_measure(calls=calls, warmup=warmup),
+    )
+
+
 def tune_trsm(
     grid: Grid,
     n: int,

@@ -899,6 +899,200 @@ def _small_solve(args, op: str):
     return rec
 
 
+def _blocktri_batch(nblocks: int, b: int, batch: int, nrhs: int, dtype,
+                    seed: int = 5):
+    """One batch of SPD block-tridiagonal chains (the serve posv_blocktri
+    geometry): D_i = G·Gᵀ/b + 3I per block (the _spd spectrum family),
+    couplings at 0.3/√b — strong enough that a sweep bug blows the
+    residual gate, weak enough that the chain stays well-conditioned
+    (block diagonal dominance).  Returns device arrays plus the f64 numpy
+    masters for --validate."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    G = rng.standard_normal((batch, nblocks, b, b))
+    D = G @ G.transpose(0, 1, 3, 2) / b + 3.0 * np.eye(b)
+    C = 0.3 / np.sqrt(b) * rng.standard_normal((batch, nblocks, b, b))
+    C[:, 0] = 0.0
+    B = rng.standard_normal((batch, nblocks, b, nrhs))
+    dev = tuple(
+        jax.block_until_ready(jnp.asarray(x, dtype)) for x in (D, C, B)
+    )
+    return dev, (D, C, B)
+
+
+def _blocktri_dense(D, C) -> "jnp.ndarray":
+    """Assemble the f64 numpy chain masters to dense (batch, n, n) —
+    NumPy-side so the reference the residual gates compare against never
+    touches the code under test (models/blocktri.assemble is itself new
+    in this round)."""
+    import numpy as np
+
+    batch, nblocks, b, _ = D.shape
+    n = nblocks * b
+    A = np.zeros((batch, n, n))
+    for i in range(nblocks):
+        sl = slice(i * b, (i + 1) * b)
+        A[:, sl, sl] = D[:, i]
+        if i:
+            up = slice((i - 1) * b, i * b)
+            A[:, sl, up] = C[:, i]
+            A[:, up, sl] = C[:, i].transpose(0, 2, 1)
+    return A
+
+
+def blocktri(args) -> dict:
+    """Bench the block-tridiagonal fast path (models/blocktri.posv) and
+    measure its wall-clock speedup against the equal-n dense batched posv
+    on the SAME problems assembled dense — the structural O(n·b³) vs
+    O(n³) win the round-11 flagship gate pins (docs/PERF.md).  Reports
+    useful-flop TF/s (the chain's O(n·b³) count, not dense n³ — so the
+    TF/s figure is comparable across impls, and the speedup column
+    carries the structural win)."""
+    from capital_tpu.models import blocktri as bt_mod
+    from capital_tpu.serve import api
+
+    dtype = jnp.dtype(args.dtype)
+    grid = Grid.square(c=1, devices=jax.devices()[:1])
+    prec = _precision(args, dtype)
+    nblocks, b, batch, nrhs = args.nblocks, args.block, args.batch, args.nrhs
+    n = nblocks * b
+    impl = args.impl
+    if impl == "auto" and jax.default_backend() != "tpu":
+        # off-TPU 'auto' pins the xla scan — pallas means the interpreter
+        # there (the _resolve_mode rationale), and a bench must measure an
+        # honest wall time.  serve keeps interpret-pallas off-TPU for its
+        # own reason (pure-HLO executables persist in the AOT disk cache);
+        # the bench and serve resolve 'auto' differently ON PURPOSE.
+        impl = "xla"
+    (Dj, Cj, Bj), (Dn, Cn, Bn) = _blocktri_batch(nblocks, b, batch, nrhs,
+                                                 dtype)
+    fn = jax.jit(
+        lambda d, c, rhs: bt_mod.posv(d, c, rhs, precision=prec, impl=impl)
+    )
+
+    if args.validate:
+        X, info = jax.block_until_ready(fn(Dj, Cj, Bj))
+        bad = int(jnp.sum(info != 0))
+        if bad:
+            sys.exit(f"validation failed: {bad} problem(s) report info != 0")
+        import numpy as np
+
+        Ad = _blocktri_dense(Dn, Cn)
+        Xn = np.asarray(X, np.float64).reshape(batch, n, nrhs)
+        Bd = Bn.reshape(batch, n, nrhs)
+        tol = _tolerance(dtype)
+        worst = max(
+            float(np.linalg.norm(Ad[i] @ Xn[i] - Bd[i])
+                  / np.linalg.norm(Bd[i]))
+            for i in range(batch)
+        )
+        _gate("blocktri_solve_residual", worst, tol)
+        # factor residual: reconstruct A from (L, Wt) blockwise in f64 —
+        # ‖A − L̃·L̃ᵀ‖_F/‖A‖_F over the whole batch
+        L, Wt, _ = jax.jit(
+            lambda d, c: bt_mod.factor(d, c, precision=prec, impl=impl)
+        )(Dj, Cj)
+        Ln = np.asarray(L, np.float64)
+        Wn = np.asarray(Wt, np.float64).transpose(0, 1, 3, 2)  # W_i
+        R = np.zeros_like(Ad)
+        for i in range(nblocks):
+            sl = slice(i * b, (i + 1) * b)
+            # A_ii = L_i·L_iᵀ + W_i·W_iᵀ  (W_1 = 0); A_{i,i−1} = W_i·L_{i−1}ᵀ
+            R[:, sl, sl] = Ln[:, i] @ Ln[:, i].transpose(0, 2, 1)
+            if i:
+                up = slice((i - 1) * b, i * b)
+                R[:, sl, sl] += Wn[:, i] @ Wn[:, i].transpose(0, 2, 1)
+                blk = Wn[:, i] @ Ln[:, i - 1].transpose(0, 2, 1)
+                R[:, sl, up] = blk
+                R[:, up, sl] = blk.transpose(0, 2, 1)
+        _gate(
+            "blocktri_factor_residual",
+            float(np.linalg.norm(R - Ad) / np.linalg.norm(Ad)),
+            tol,
+        )
+
+    # useful flops per chain: factor nblocks·(b³/3 chol + b³ trsm + 2b³
+    # Schur) + solve nblocks·2 sweeps·(b²k trsm + 2b²k coupling gemm)
+    flops = batch * nblocks * (b**3 / 3.0 + 3.0 * b**3
+                               + 6.0 * b * b * nrhs)
+
+    if args.latency:
+        samples = harness.latency_samples(
+            lambda: fn(Dj, Cj, Bj), calls=args.calls, warmup=3
+        )
+        pcts = harness.percentiles(samples)
+        from capital_tpu.obs.ledger import SCHEMA_VERSION
+
+        rec = {
+            "metric": "blocktri_latency",
+            "schema_version": SCHEMA_VERSION,
+            "value": round(1.0 / pcts["p99"], 3),
+            "unit": "batch/s",
+            "seconds": pcts["p99"],
+            "wall_ms": {k: round(v * 1e3, 4) for k, v in pcts.items()},
+            "dtype": str(dtype),
+            "device": jax.devices()[0].device_kind,
+            "platform": jax.default_backend(),
+            "nblocks": nblocks, "block": b, "n": n, "batch": batch,
+            "nrhs": nrhs, "impl": impl, "calls": args.calls,
+        }
+        import json as _json
+
+        print(_json.dumps(rec))
+        _ledger_append(args, rec, name="blocktri_latency", grid=grid,
+                       dtype=dtype,
+                       cfg={"op": "posv_blocktri", "impl": impl})
+        return rec
+
+    samples = harness.latency_samples(
+        lambda: fn(Dj, Cj, Bj), calls=max(args.iters, 3), warmup=3
+    )
+    t = sum(samples) / len(samples)
+
+    # dense comparison on the same problems, per-problem amortized both
+    # sides; the dense batch shrinks when batch·n² won't reasonably fit
+    # (the structural point survives — per-problem time is the comparand)
+    dense_batch = batch
+    dense_bytes = batch * n * n * dtype.itemsize
+    if dense_bytes > 2e9:
+        dense_batch = max(1, int(2e9 // (n * n * dtype.itemsize)))
+    Adj = jax.block_until_ready(
+        jnp.asarray(_blocktri_dense(Dn[:dense_batch], Cn[:dense_batch]),
+                    dtype))
+    Bdj = Bj[:dense_batch].reshape(dense_batch, n, nrhs)
+    dense_fn = jax.jit(api.batched("posv", prec, args.small_impl))
+    dsamples = harness.latency_samples(
+        lambda: dense_fn(Adj, Bdj), calls=max(args.iters, 3), warmup=1
+    )
+    t_dense = sum(dsamples) / len(dsamples)
+    speedup = (t_dense / dense_batch) / (t / batch)
+    print(f"# speedup {speedup:.1f}x vs dense posv n={n} "
+          f"(dense {t_dense / dense_batch * 1e3:.1f} ms/problem, "
+          f"blocktri {t / batch * 1e3:.3f} ms/problem)")
+
+    rec = harness.report(
+        "blocktri_tflops", t, flops, dtype, nblocks=nblocks, block=b, n=n,
+        batch=batch, nrhs=nrhs, impl=impl, grid=repr(grid),
+        speedup=round(speedup, 2),
+        dense_ms=round(t_dense / dense_batch * 1e3, 3),
+        wall_ms={k: round(v * 1e3, 4)
+                 for k, v in harness.percentiles(samples).items()},
+    )
+    if args.min_speedup and speedup < args.min_speedup:
+        _ledger_append(args, rec, name="blocktri", grid=grid, dtype=dtype,
+                       cfg={"op": "posv_blocktri", "impl": impl,
+                            "nblocks": nblocks, "block": b})
+        sys.exit(
+            f"speedup gate failed: {speedup:.1f}x < {args.min_speedup}x "
+            f"vs dense posv at n={n}"
+        )
+    _ledger_append(args, rec, name="blocktri", grid=grid, dtype=dtype,
+                   cfg={"op": "posv_blocktri", "impl": impl,
+                        "nblocks": nblocks, "block": b})
+    return rec
+
+
 def posv(args):
     return _small_solve(args, "posv")
 
@@ -917,6 +1111,7 @@ DRIVERS = {
     "trsm": trsm,
     "posv": posv,
     "lstsq": lstsq,
+    "blocktri": blocktri,
 }
 
 
@@ -1015,6 +1210,27 @@ def build_parser() -> argparse.ArgumentParser:
         choices=["auto", "vmap", "pallas", "pallas_split"],
         help="posv/lstsq: batched implementation (api.batched impl switch; "
         "auto resolves from the bucket shape like serve does)",
+    )
+    p.add_argument(
+        "--nblocks", type=int, default=8,
+        help="blocktri: chain length (diagonal blocks per problem)",
+    )
+    p.add_argument(
+        "--block", type=int, default=32,
+        help="blocktri: block size b (each diagonal block is b x b; "
+        "n = nblocks * block)",
+    )
+    p.add_argument(
+        "--impl", default="auto", choices=["auto", "pallas", "xla"],
+        help="blocktri: chain implementation; auto = pallas scan on TPU, "
+        "xla scan elsewhere (off-TPU pallas is the interpreter — serve "
+        "keeps it there for AOT-cache persistability, a bench must not)",
+    )
+    p.add_argument(
+        "--min-speedup", type=float, default=0.0,
+        help="blocktri: fail the run when the measured per-problem "
+        "speedup vs equal-n dense posv lands below this factor "
+        "(the round-11 flagship gate: 25 at nblocks=64, block=128, f32)",
     )
     p.add_argument(
         "--phase-attr", action="store_true",

@@ -21,7 +21,7 @@ import jax.numpy as jnp
 from capital_tpu.lint.program import ProgramTarget
 
 TARGET_NAMES = ("cholinv", "cacqr", "serve", "batched_small", "serve_sched",
-                "cholinv_fused")
+                "cholinv_fused", "blocktri")
 
 
 def _grid():
@@ -139,6 +139,35 @@ def batched_small_targets(
     ]
 
 
+def blocktri_target(
+    nblocks: int = 4, b: int = 16, nrhs: int = 2, capacity: int = 4,
+    dtype=jnp.float32,
+) -> ProgramTarget:
+    """The serve posv_blocktri bucket program (models/blocktri through
+    api.batched, the executable engine._get_batched compiles): one fused
+    factor+forward scan under ``BT::factor`` feeding the backward sweep
+    under ``BT::solve`` — both phase tags under the phase-coverage rule,
+    and the scan-carried pallas steps under cache-key hygiene.  Forced
+    impl='pallas' so the lint sees the kernel route serve routes on TPU
+    regardless of the CPU rig's default_impl answer.  ``flops_audited=
+    False``: the chain flops execute inside interpreted ``pallas_call``
+    scan bodies on the CPU rig, invisible to XLA ``cost_analysis`` (same
+    reasoning as batched_small_targets).  No donation — the engine
+    donates nothing for posv_blocktri (the packed (2, nblocks, b, b)
+    operand can't alias the (nblocks, b, nrhs) solution shape-wise, and
+    the RHS aliasing lives inside the kernels)."""
+    from capital_tpu.serve import api
+
+    dt = jnp.dtype(dtype)
+    a_sds = jax.ShapeDtypeStruct((capacity, 2, nblocks, b, b), dt)
+    b_sds = jax.ShapeDtypeStruct((capacity, nblocks, b, nrhs), dt)
+    return ProgramTarget(
+        name=f"serve-blocktri-b{capacity}-nb{nblocks}-bs{b}",
+        fn=api.batched("posv_blocktri", impl="pallas"),
+        args=(a_sds, b_sds), flops_audited=False,
+    )
+
+
 def cholinv_fused_target(n: int = 512, dtype=jnp.float32) -> ProgramTarget:
     """The fused-recursion-tail cholinv program (CholinvConfig.
     tail_fuse_depth > 0): n=512 with bc=128 and depth 2 fuses the whole
@@ -225,6 +254,8 @@ def flagship_targets(names=None) -> list[ProgramTarget]:
             out.append(serve_sched_target())
         elif name == "cholinv_fused":
             out.append(cholinv_fused_target())
+        elif name == "blocktri":
+            out.append(blocktri_target())
         else:
             raise ValueError(
                 f"unknown lint target {name!r}; expected one of {TARGET_NAMES}"

@@ -636,42 +636,8 @@ def _recurse(
     return buf, Rp, RIp
 
 
-def _combine_tail_info(
-    info: jnp.ndarray, tail_infos: list, n: int
-) -> jnp.ndarray:
-    """Fold the fused-tail kernels' in-kernel info scalars into the global
-    post-hoc status (robust/detect.factor_info of the cropped R).
-
-    This is NOT redundant with factor_info: the fused sweep's guarded
-    rsqrt turns a bad pivot into finite garbage (no NaN fill the post-hoc
-    diagonal scan is guaranteed to see), and when the garbage DOES
-    overflow, the sweep's one-hot outer products turn inf into 0·inf NaNs
-    across the whole window — including rows factored BEFORE the
-    breakdown — so the scan's first-bad-diagonal position inside a broken
-    fused window is backward pollution, not the true pivot.  The kernel's
-    own info is authoritative there: post-hoc pivot positions that fall
-    inside a broken fused window are dropped first, then every window's
-    candidate merges in.  Per window at diagonal offset `dest` with local
-    size nw: local w in [1, nw] maps to global pivot dest+w (1-based,
-    ignored when it falls in the identity pad beyond n); w == nw+1
-    (off-diagonal contamination) maps to the global n+1.  The global
-    status is the FIRST bad pivot — the minimum over all flagged
-    positions, which also ranks any pivot (<= n) above the off-diagonal
-    sentinel n+1, matching the factor_info precedence."""
-    for dest, nw, w in tail_infos:
-        broken = w.astype(info.dtype) > 0
-        inside = (info > dest) & (info <= dest + nw) & (info <= n)
-        info = jnp.where(broken & inside, 0, info)
-    for dest, nw, w in tail_infos:
-        w = w.astype(info.dtype)
-        piv = jnp.where((w > 0) & (w <= nw) & (dest + w <= n), dest + w, 0)
-        offd = jnp.where(w == nw + 1, jnp.asarray(n + 1, info.dtype), 0)
-        cand = jnp.where(piv > 0, piv, offd)
-        info = jnp.where(
-            info == 0, cand,
-            jnp.where(cand == 0, info, jnp.minimum(info, cand)),
-        )
-    return info
+# The fused-tail info min-combine lives in robust/detect.combine_block_infos
+# — shared with the per-chain-block infos of models/blocktri.py.
 
 
 @pallas_tpu.scoped_by_grid
@@ -778,7 +744,7 @@ def factor(
         if cfg.robust is not None:
             info = detect.factor_info(R)
             if tail_infos:
-                info = _combine_tail_info(info, tail_infos, n)
+                info = detect.combine_block_infos(info, tail_infos, n)
             return R, Rinv, info
         return R, Rinv
 
@@ -817,7 +783,7 @@ def factor(
     if cfg.robust is not None:
         info = detect.factor_info(R)
         if tail_infos:
-            info = _combine_tail_info(info, tail_infos, n)
+            info = detect.combine_block_infos(info, tail_infos, n)
         return R, Rinv, info
     return R, Rinv
 

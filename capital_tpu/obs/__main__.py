@@ -240,6 +240,13 @@ def _serve_report(args) -> int:
             f"device p99={rs['device_ms']['p99']}"
             if qwait and rs.get("device_ms") else ""
         )
+        # per-op request mix (Collector.ops) — includes posv_blocktri
+        # since the chain op joined the serve surface
+        ops = rs.get("ops")
+        ops_note = (
+            " ops " + " ".join(f"{k}={ops[k]}" for k in sorted(ops))
+            if ops else ""
+        )
         print(
             f"# [{i}] {man.get('platform', '?')}/{man.get('device', '?')} "
             f"requests={rs['requests']} ok={rs['ok']} "
@@ -248,7 +255,8 @@ def _serve_report(args) -> int:
             f"occupancy={rs['batch_occupancy_mean']} "
             f"queue_max={rs['queue_depth_max']} "
             f"cache hits={cache['hits']} misses={cache['misses']} "
-            f"hit_rate={cache['hit_rate']:.3f}" + small_note + split_note
+            f"hit_rate={cache['hit_rate']:.3f}"
+            + small_note + split_note + ops_note
         )
         if (args.min_hit_rate is not None
                 and cache["hit_rate"] < args.min_hit_rate):

@@ -233,6 +233,10 @@ _REQ_STATS_COUNTS = ("requests", "ok", "flagged", "failed",
                      "queue_depth_max", "batches")
 _REQ_STATS_PCTS = ("p50", "p95", "p99")
 _REQ_STATS_CACHE = ("hits", "misses", "warmup_compiles", "hit_rate")
+#: per-op request counters (serve/stats.Collector.ops): every key must be a
+#: serve op this tooling knows (batching.OPS, inlined so obs never imports
+#: serve) — an unknown key means the producer and the tooling drifted apart.
+_REQ_STATS_OPS = ("posv", "lstsq", "inv", "posv_blocktri")
 
 
 def validate_request_stats(block) -> list[str]:
@@ -274,6 +278,23 @@ def validate_request_stats(block) -> list[str]:
         probs.append(
             f"batch_occupancy_mean must be in [0, 1], got {occ!r}"
         )
+    # optional per-op counters (Collector.ops, present since the op mix
+    # grew past posv/lstsq): records that predate them stay valid unchanged
+    if "ops" in block:
+        ops = block["ops"]
+        if not isinstance(ops, dict):
+            probs.append(f"ops must be an object, got {ops!r}")
+        else:
+            for name, v in ops.items():
+                if name not in _REQ_STATS_OPS:
+                    probs.append(
+                        f"ops key {name!r} is not a known serve op "
+                        f"{_REQ_STATS_OPS}"
+                    )
+                if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                    probs.append(
+                        f"ops[{name!r}] must be a non-negative int, got {v!r}"
+                    )
     # optional percentile blocks, validated whenever present, same posture
     # as the rest of the block:
     #   latency_ms_small — small-N split (serve small_n_impl pallas
@@ -437,6 +458,51 @@ def validate_phase_seconds(measured) -> list[str]:
     return probs
 
 
+#: blocktri chain impls the bench driver can report (models/blocktri.IMPLS).
+_BLOCKTRI_IMPLS = ("auto", "pallas", "xla")
+
+
+def validate_blocktri_measured(measured) -> list[str]:
+    """Schema problems of a bench:blocktri measured block ([] = valid) —
+    the chain-geometry fields the blocktri driver emits (nblocks / block /
+    n consistency, the speedup column, the wall_ms split).  Same
+    exemption-with-validation posture as request_stats: diff() validates
+    every record carrying a blocktri metric (malformed ->
+    LedgerIncompatible) while the metric itself still compares normally —
+    both blocktri metrics are rate-shaped (TFLOP/s, batch/s), so a
+    value drop reads as "slower" like every other bench row."""
+    if not isinstance(measured, dict):
+        return [f"measured is {type(measured).__name__}, expected object"]
+    probs = []
+    for key in ("nblocks", "block", "n", "batch", "nrhs"):
+        v = measured.get(key)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+            probs.append(f"{key} must be a positive int, got {v!r}")
+    nb, b, n = (measured.get(k) for k in ("nblocks", "block", "n"))
+    if (isinstance(nb, int) and isinstance(b, int) and isinstance(n, int)
+            and n != nb * b):
+        probs.append(f"n {n} != nblocks*block {nb * b}")
+    if measured.get("impl") not in _BLOCKTRI_IMPLS:
+        probs.append(
+            f"impl must be one of {_BLOCKTRI_IMPLS}, "
+            f"got {measured.get('impl')!r}"
+        )
+    if "speedup" in measured:
+        sp = measured["speedup"]
+        if (not isinstance(sp, (int, float)) or isinstance(sp, bool)
+                or not sp > 0):
+            probs.append(f"speedup must be a positive number, got {sp!r}")
+    wm = measured.get("wall_ms")
+    if wm is not None:
+        if not isinstance(wm, dict):
+            probs.append(f"wall_ms must be an object, got {wm!r}")
+        else:
+            for p in _REQ_STATS_PCTS:
+                if not isinstance(wm.get(p), (int, float)):
+                    probs.append(f"wall_ms.{p} missing or non-numeric")
+    return probs
+
+
 def _event_status(rec: dict) -> Optional[str]:
     """The robustness status of a record, when it carries one.
 
@@ -515,6 +581,14 @@ def diff(
             if probs:
                 raise LedgerIncompatible(
                     "malformed phase attribution record: " + "; ".join(probs)
+                )
+        if isinstance(meas, dict) and str(
+            meas.get("metric", "")
+        ).startswith("blocktri"):
+            probs = validate_blocktri_measured(meas)
+            if probs:
+                raise LedgerIncompatible(
+                    "malformed blocktri bench record: " + "; ".join(probs)
                 )
     a_by = {_key(r): r for r in a_recs}
     b_by = {_key(r): r for r in b_recs}

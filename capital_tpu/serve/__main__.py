@@ -45,19 +45,22 @@ import jax
 
 
 def _workload(requests: int, seed: int):
-    """Deterministic mixed workload touching >= 3 n-buckets, all three ops,
-    and two nrhs buckets."""
+    """Deterministic mixed workload touching >= 3 dense n-buckets, all
+    four ops (dense posv/inv/lstsq + the structured posv_blocktri), two
+    nrhs buckets, and two blocktri (nblocks, b) buckets — the mixed
+    dense + structured traffic the zero-recompile gate must cover."""
     import numpy as np
 
     rng = np.random.default_rng(seed)
     ns = (12, 24, 48, 16, 30, 64)  # -> buckets 16 / 32 / 64
     ks = (1, 3)  # -> nrhs buckets 1 / 4
+    bts = ((3, 6), (6, 12), (4, 24))  # -> (nblocks, b) buckets
     # 5-long op cycle against the 6-long n cycle (coprime) so blocks sweep
     # the bucket grid; requests arrive in blocks of 4 IDENTICAL shapes
     # (j = i // 4) so the capacity flush path sees full batches, while the
     # pump() cadence below (every 7 submissions, coprime with 4) still
     # catches partial blocks on the deadline path
-    ops = ("posv", "inv", "lstsq", "posv", "lstsq")
+    ops = ("posv", "inv", "lstsq", "posv_blocktri", "lstsq")
     out = []
     for i in range(requests):
         j = i // 4
@@ -68,6 +71,14 @@ def _workload(requests: int, seed: int):
             m = 4 * n
             A = rng.standard_normal((m, n))
             B = rng.standard_normal((m, k))
+        elif op == "posv_blocktri":
+            nb, bb = bts[j % len(bts)]
+            G = rng.standard_normal((nb, bb, bb))
+            D = G @ G.transpose(0, 2, 1) / bb + 3.0 * np.eye(bb)
+            C = 0.3 / np.sqrt(bb) * rng.standard_normal((nb, bb, bb))
+            C[0] = 0.0
+            A = np.stack([D, C])
+            B = rng.standard_normal((nb, bb, k))
         else:
             M = rng.standard_normal((n, n))
             A = M @ M.T / n + 3.0 * np.eye(n)
@@ -85,6 +96,22 @@ def _residual(op: str, A, B, x) -> float:
         n = A.shape[0]
         return float(np.linalg.norm(A @ x - np.eye(n)) / np.sqrt(n))
     B = np.asarray(B, dtype=np.float64)
+    if op == "posv_blocktri":
+        # assemble the dense matrix the chain represents and gate the
+        # flattened solve residual like dense posv
+        _, nb, bb, _ = A.shape
+        n = nb * bb
+        Ad = np.zeros((n, n))
+        for i in range(nb):
+            sl = slice(i * bb, (i + 1) * bb)
+            Ad[sl, sl] = A[0, i]
+            if i:
+                up = slice((i - 1) * bb, i * bb)
+                Ad[sl, up] = A[1, i]
+                Ad[up, sl] = A[1, i].T
+        k = B.shape[-1]
+        Bf, xf = B.reshape(n, k), x.reshape(n, k)
+        return float(np.linalg.norm(Ad @ xf - Bf) / np.linalg.norm(Bf))
     if op == "posv":
         return float(np.linalg.norm(A @ x - B) / np.linalg.norm(B))
     r = A.T @ (A @ x - B)
@@ -102,6 +129,11 @@ def _smoke(args) -> int:
         buckets=(16, 32, 64),
         rows_buckets=(64, 128, 256),
         nrhs_buckets=(1, 4),
+        # the structured ladder: the workload's (nblocks, b) chains stay
+        # tiny so the interpret-mode scan is cheap, while still touching
+        # two rungs of each blocktri axis
+        nblocks_buckets=(4, 8),
+        block_buckets=(8, 16, 32),
         max_batch=4,
         max_delay_s=0.01,
         # every smoke bucket is <= batched_small.SMALL_N_MAX, so 'auto'

@@ -58,7 +58,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from capital_tpu.models import cholesky, qr
+from capital_tpu.models import blocktri, cholesky, qr
 from capital_tpu.ops import batched_small, lapack
 from capital_tpu.parallel import summa
 from capital_tpu.utils import tracing
@@ -177,6 +177,28 @@ def _batched_pallas(op: str, precision, split: bool):
     return f
 
 
+def _batched_blocktri(precision, impl: str):
+    """The block-tridiagonal bucket program: unpack the (batch, 2,
+    nblocks, b, b) chain packing (A[:, 0] = diagonal blocks, A[:, 1] =
+    sub-diagonal blocks) and run the fused scan-of-Pallas-blocks posv
+    (models/blocktri).  The serve-wide impl vocabulary (batched_small.
+    IMPLS, what ServeConfig.small_n_impl speaks) maps onto blocktri's
+    own: 'vmap' means the pure lax.linalg scan ('xla' — there is no
+    per-problem LAPACK route for the chain), 'pallas_split' means
+    'pallas' (the chain has no split form; the scan IS the split).
+    Resolution reads only static shapes/dtypes (models/blocktri
+    ._resolve_impl, incl. the f64-always-xla gate), so the engine's
+    zero-recompile invariant holds."""
+    mapped = {"auto": "auto", "pallas": "pallas",
+              "pallas_split": "pallas", "vmap": "xla"}[impl]
+
+    def f(a, b):
+        return blocktri.posv(a[:, 0], a[:, 1], b, precision=precision,
+                             impl=mapped)
+
+    return f
+
+
 def batched(op: str, precision: str | None = "highest",
             impl: str = "auto"):
     """The function the engine AOT-compiles for one bucket: maps the fixed
@@ -194,6 +216,8 @@ def batched(op: str, precision: str | None = "highest",
             f"unknown batched impl {impl!r}: expected one of "
             f"{batched_small.IMPLS}"
         )
+    if op == "posv_blocktri":
+        return _batched_blocktri(precision, impl)
     if impl == "vmap":
         return _batched_vmap(op, precision)
     if impl in ("pallas", "pallas_split"):
@@ -275,6 +299,16 @@ def single(op: str, grid, precision: str | None = "highest", robust=None,
                 mode=ccfg.mode,
             )
             return ainv, info
+
+        return f
+    if op == "posv_blocktri":
+        # oversize chains run as a batch of one through the same scan
+        # paths (there is no distributed blocktri schedule — the chain is
+        # sequential; `grid` is accepted for signature uniformity).
+        def f(a, b):
+            X, info = blocktri.posv(a[None, 0], a[None, 1], b[None],
+                                    precision=precision)
+            return X[0], (info[0] if robust is not None else jnp.int32(0))
 
         return f
     raise ValueError(f"unknown serve op {op!r}")

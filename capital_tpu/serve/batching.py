@@ -31,7 +31,7 @@ import jax.numpy as jnp
 from capital_tpu.ops import masking
 from capital_tpu.utils import tracing
 
-OPS = ("posv", "lstsq", "inv")
+OPS = ("posv", "lstsq", "inv", "posv_blocktri")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -68,9 +68,24 @@ def bucket_for(op: str, a_shape, b_shape, dtype: str, cfg) -> Bucket | None:
 
     lstsq rows bucket at `m + (nb - n)`: the column pad appends one unit
     column PER padded column and each needs its own appended row
-    (masking.embed_identity_tail's rows - m >= cols - n contract)."""
+    (masking.embed_identity_tail's rows - m >= cols - n contract).
+
+    posv_blocktri packs the chain as A = (2, nblocks, b, b) — A[0] the
+    diagonal blocks, A[1] the sub-diagonal blocks (A[1, 0] dead) — and
+    B = (nblocks, b, nrhs), bucketing nblocks and b on their own ladders
+    (cfg.nblocks_buckets / cfg.block_buckets); nrhs shares the dense
+    ladder."""
     if op not in OPS:
         raise ValueError(f"unknown serve op {op!r}; expected one of {OPS}")
+    if op == "posv_blocktri":
+        _, nblocks, b, _ = a_shape
+        nbb = _pick(cfg.nblocks_buckets, nblocks)
+        bb = _pick(cfg.block_buckets, b)
+        kb = _pick(cfg.nrhs_buckets, b_shape[2])
+        if nbb is None or bb is None or kb is None:
+            return None
+        return Bucket(op, dtype, (2, nbb, bb, bb), (nbb, bb, kb),
+                      cfg.max_batch)
     if op in ("posv", "inv"):
         n = a_shape[0]
         nb = _pick(cfg.buckets, n)
@@ -99,6 +114,8 @@ def pad_operands(op: str, A, B, bucket: Bucket):
     RHS.  Host-side eager (submit time), tagged serve::pad so profiler
     traces attribute the pad cost to the serving layer."""
     with tracing.scope("serve::pad"):
+        if op == "posv_blocktri":
+            return _pad_blocktri(A, B, bucket)
         pa = masking.embed_identity_tail(A, *bucket.a_shape)
         pb = None
         if bucket.b_shape is not None:
@@ -109,11 +126,42 @@ def pad_operands(op: str, A, B, bucket: Bucket):
         return pa, pb
 
 
+def _pad_blocktri(A, B, bucket: Bucket):
+    """Structure-safe pad for the block-tridiagonal chain: every diagonal
+    block gets the per-block identity-tail embed diag(D_i, I) (the Schur
+    chain preserves diag(·, I) exactly — all products are 0·x or 1·x),
+    sub-diagonal and RHS blocks zero-pad, and appended chain blocks are
+    pure identity problems with zero couplings — the padded operand stays
+    block-tridiagonal SPD and the real blocks' solution is BITWISE the
+    unpadded one (the chain is sequential, so trailing identity blocks
+    never feed back; their forward/backward carries are exact zeros)."""
+    _, nblocks, b, _ = A.shape
+    nbb, bb = bucket.a_shape[1], bucket.a_shape[2]
+    kb = bucket.b_shape[2]
+    pa = jnp.pad(A, ((0, 0), (0, nbb - nblocks),
+                     (0, bb - b), (0, bb - b)))
+    eye = jnp.eye(bb, dtype=A.dtype)
+    # real blocks complete to diag(D_i, I); appended blocks become I
+    tail = jnp.where(jnp.arange(bb) >= b, eye, jnp.zeros_like(eye))
+    blk = (jnp.arange(nbb) < nblocks)[:, None, None]
+    pa = pa.at[0].add(jnp.where(blk, tail, eye))
+    pb = jnp.pad(B, ((0, nbb - nblocks), (0, bb - b),
+                     (0, kb - B.shape[2])))
+    return pa, pb
+
+
 def fill_problem(bucket: Bucket):
     """The benign problem that tops a short batch up to capacity: an
     identity operand (SPD for posv/inv, orthonormal columns for lstsq —
-    its gram is I, so every op factors it cleanly) against a zero RHS."""
+    its gram is I, so every op factors it cleanly) against a zero RHS.
+    For posv_blocktri the fill is the identity CHAIN: identity diagonal
+    blocks, zero couplings — every block factors to L = I exactly."""
     dt = jnp.dtype(bucket.dtype)
+    if bucket.op == "posv_blocktri":
+        _, nbb, bb, _ = bucket.a_shape
+        eyes = jnp.broadcast_to(jnp.eye(bb, dtype=dt), (nbb, bb, bb))
+        fa = jnp.stack([eyes, jnp.zeros((nbb, bb, bb), dt)])
+        return fa, jnp.zeros(bucket.b_shape, dtype=dt)
     fa = jnp.eye(*bucket.a_shape, dtype=dt)
     fb = None
     if bucket.b_shape is not None:
@@ -146,4 +194,6 @@ def crop(op: str, X, a_shape, b_shape):
         return X[: a_shape[0], : b_shape[1]]
     if op == "lstsq":
         return X[: a_shape[1], : b_shape[1]]
+    if op == "posv_blocktri":
+        return X[: a_shape[1], : a_shape[2], : b_shape[2]]
     return X[: a_shape[0], : a_shape[0]]

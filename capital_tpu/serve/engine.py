@@ -47,7 +47,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from capital_tpu.ops import batched_small
+from capital_tpu.models import blocktri
+from capital_tpu.ops import batched_small, blocktri_small
 from capital_tpu.parallel.topology import Grid
 from capital_tpu.robust import faultinject
 from capital_tpu.robust.config import RobustConfig
@@ -72,6 +73,13 @@ class ServeConfig:
     buckets: the n ladder (SPD dimension / lstsq columns).
     rows_buckets: the lstsq m ladder (requests bucket at m + column-pad).
     nrhs_buckets: the RHS-columns ladder.
+    nblocks_buckets: the posv_blocktri chain-length ladder (number of
+        diagonal blocks; padded chains append identity blocks with zero
+        couplings — bitwise-inert, the chain is sequential).
+    block_buckets: the posv_blocktri block-size ladder (per-block b;
+        padded blocks embed diag(D_i, I)).  Both join the config hash
+        with the dense ladders — the blocktri buckets AOT-cache alongside
+        dense buckets under the same discipline.
     max_batch: per-bucket batch capacity — one executable per bucket at
         this fixed batch size; also the submit-time flush threshold.
     max_delay_s: oldest-request age that forces a flush at pump() — the
@@ -114,6 +122,8 @@ class ServeConfig:
     buckets: tuple[int, ...] = (256, 512, 1024)
     rows_buckets: tuple[int, ...] = (4096, 16384, 65536)
     nrhs_buckets: tuple[int, ...] = (1, 8, 64)
+    nblocks_buckets: tuple[int, ...] = (8, 32, 64)
+    block_buckets: tuple[int, ...] = (32, 64, 128)
     max_batch: int = 8
     max_delay_s: float = 0.005
     precision: Optional[str] = "highest"
@@ -171,6 +181,7 @@ class SolveEngine:
         # max_inflight / persist_dir are deliberately absent: they change
         # when and where programs run, never what was compiled.
         ident = repr((cfg.buckets, cfg.rows_buckets, cfg.nrhs_buckets,
+                      cfg.nblocks_buckets, cfg.block_buckets,
                       cfg.max_batch, cfg.precision, cfg.robust,
                       cfg.small_n_impl, cfg.tail_fuse_depth))
         self._cfg_hash = hashlib.sha1(ident.encode()).hexdigest()[:12]
@@ -191,6 +202,18 @@ class SolveEngine:
             # forced pallas included: api._batched_pallas falls back to the
             # vmap program for f64, so the executable is NOT small-route
             return False
+        if bucket.op == "posv_blocktri":
+            # the chain resolves through blocktri_small's own gate (per
+            # scan step, not per bucket problem); impl mapping mirrors
+            # api._batched_blocktri ('vmap'->xla handled above, forced
+            # pallas variants below)
+            if impl in ("pallas", "pallas_split"):
+                return True
+            _, nblocks, b, _ = bucket.a_shape
+            seg = blocktri.resolve_seg(nblocks)
+            return blocktri_small.default_impl(
+                b, bucket.b_shape[2], seg, bucket.dtype
+            ) == "pallas"
         if impl in ("pallas", "pallas_split"):
             return True
         a_shape = (bucket.capacity,) + bucket.a_shape
@@ -304,6 +327,19 @@ class SolveEngine:
             raise ValueError(
                 f"unknown serve op {op!r}; expected one of {batching.OPS}"
             )
+        if op == "posv_blocktri":
+            if (A.ndim != 4 or A.shape[0] != 2
+                    or A.shape[2] != A.shape[3]):
+                raise ValueError(
+                    f"posv_blocktri needs A = (2, nblocks, b, b) — "
+                    f"[diagonal blocks, sub-diagonal blocks] — got "
+                    f"{A.shape}"
+                )
+            if B is None or B.ndim != 3 or B.shape[:2] != A.shape[1:3]:
+                raise ValueError(
+                    f"posv_blocktri needs B = (nblocks, b, nrhs) riding "
+                    f"A {A.shape}, got {None if B is None else B.shape}"
+                )
         if op in ("posv", "lstsq") and (B is None or B.ndim != 2
                                         or B.shape[0] != A.shape[0]):
             raise ValueError(

@@ -152,6 +152,16 @@ PHASE_REGISTRY: tuple[str, ...] = (
     # the batched bucket dispatch itself — the boundary the queue_wait /
     # device latency split in serve/stats.py measures across.
     "SV::stage", "SV::dispatch",
+    # block-tridiagonal chain (models/blocktri.py, docs/SERVING.md).  The
+    # scopes wrap the lax.scan CALLS at the models layer, not the scan
+    # bodies: an emit inside a scan body would fire once at trace time
+    # while the kernel executes nsteps times, so the whole chain is priced
+    # outside the scan and the lint phase-inheritance rule extends the
+    # scope over the scanned kernels.  BT::factor covers the Schur-
+    # complement factor chain (fused with the forward sweep in
+    # posv_blocktri — one phase, one price, the SV::fused_posv rationale);
+    # BT::solve covers the block-bidiagonal substitution sweeps.
+    "BT::factor", "BT::solve",
 )
 _PHASE_SET: set[str] = set(PHASE_REGISTRY)
 
@@ -492,6 +502,26 @@ def fused_tail_flops(n: int) -> float:
     subtree's potrf/trsm/syrk/trmm phases, so this single price replaces
     every per-phase emit the unfused recursion would have issued."""
     return batched_chol_flops(n) + batched_trsm_flops(n, n)
+
+
+def blocktri_chol_flops(nblocks: int, b: int) -> float:
+    """Block-tridiagonal factor chain, per problem (BT::factor): each of
+    `nblocks` chain blocks runs one masked column-sweep Cholesky of the
+    (b, b) Schur complement, one forward substitution sweep for
+    Wt = L⁻¹·Cᵀ at k=b, the identity-contraction transpose of C (2b³),
+    and the Wtᵀ·Wt Schur update (2b³).  Executed flops, like every
+    batched-small price — the textbook useful count is nblocks·(b³/3+3b³)
+    (the bench driver's numerator)."""
+    return nblocks * (batched_chol_flops(b) + batched_trsm_flops(b, b)
+                      + 4.0 * b**3)
+
+
+def blocktri_solve_flops(nblocks: int, b: int, k: int) -> float:
+    """ONE block-bidiagonal substitution sweep (forward or backward), per
+    problem (BT::solve): per chain block, one (b, b) triangular sweep at
+    width k plus the 2b²k off-diagonal coupling product.  A full potrs
+    analog is two of these."""
+    return nblocks * (batched_trsm_flops(b, k) + 2.0 * b**2 * k)
 
 
 def fused_lstsq_flops(m: int, n: int, k: int) -> float:

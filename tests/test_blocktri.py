@@ -1,0 +1,457 @@
+"""Block-tridiagonal Cholesky fast-path tests (ISSUE 10 acceptance).
+
+The properties pinned here, mapped to the issue's criteria:
+
+* factor/solve/posv match the dense reference on the assembled matrix —
+  and an independent SciPy banded solver — across (nblocks, b) ladders,
+  xla f64 and pallas f32/bf16 (TestParity);
+* the serve pad is structure-safe: appended identity chain blocks leave
+  the real blocks' solution BITWISE unchanged (the chain is sequential,
+  trailing blocks never feed back), in-block diag(D, I) embeds stay
+  tight, and fill problems solve to exact zeros (TestPadding);
+* per-block breakdown infos min-combine to one global LAPACK-convention
+  pivot: a negative diagonal pins the EXACT global index, a NaN pins the
+  block range while batch neighbors stay healthy, and the n+1 sentinel
+  survives the merge (TestInfo, the combine_block_infos regression);
+* dispatch plumbing: seg resolution, the f64 forced-pallas fallback
+  (PR 6 contract: no silent precision downgrade), dead-C[0] hygiene,
+  shape validation (TestDispatch);
+* the engine buckets posv_blocktri with the zero-recompile invariant
+  (same bucket -> cache hit), counts it in request_stats.ops, and keeps
+  blocktri ladders in the config hash (TestServeBlocktri);
+* bench:blocktri ledger records validate structurally and a malformed
+  one is LedgerIncompatible, not silently compared (TestLedgerSeam).
+
+Everything runs on the conftest CPU rig (x64 on): f64 chains resolve to
+the xla scan, tests that want the pallas kernels say float32 explicitly
+(interpret=None resolves to interpret mode off-TPU, so tier-1 executes
+the actual kernel bodies).  Long chains (nblocks=256) are slow-marked.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from capital_tpu.models import blocktri
+from capital_tpu.obs import ledger
+from capital_tpu.robust import detect
+from capital_tpu.serve import ServeConfig, SolveEngine, batching
+
+# Small ladders so every executable compiles in well under a second (same
+# posture as test_serve.CFG); blocktri gets its own two ladders.
+BT_CFG = ServeConfig(
+    buckets=(8, 16),
+    rows_buckets=(32,),
+    nrhs_buckets=(1, 4),
+    max_batch=2,
+    max_delay_s=10.0,
+    nblocks_buckets=(2, 4),
+    block_buckets=(4, 8),
+)
+
+
+def _chain(rng, batch, nblocks, b, k, dtype=np.float64):
+    """A well-conditioned SPD chain + RHS (the sweep/driver operand
+    recipe: gram/b + 3I diagonals, 0.3/sqrt(b) couplings, C[:, 0] dead)."""
+    G = rng.standard_normal((batch, nblocks, b, b))
+    D = G @ G.transpose(0, 1, 3, 2) / b + 3.0 * np.eye(b)
+    C = 0.3 / np.sqrt(b) * rng.standard_normal((batch, nblocks, b, b))
+    C[:, 0] = 0.0
+    B = rng.standard_normal((batch, nblocks, b, k))
+    return D.astype(dtype), C.astype(dtype), B.astype(dtype)
+
+
+def _np_dense(D, C):
+    """NumPy-side dense assembly of one problem's chain — independent of
+    blocktri.assemble, so the reference never touches the code under
+    test (the bench-driver discipline)."""
+    nblocks, b = D.shape[0], D.shape[1]
+    n = nblocks * b
+    A = np.zeros((n, n), dtype=np.float64)
+    for i in range(nblocks):
+        sl = slice(i * b, (i + 1) * b)
+        A[sl, sl] = D[i]
+        if i:
+            up = slice((i - 1) * b, i * b)
+            A[sl, up] = C[i]
+            A[up, sl] = C[i].T
+    return A
+
+
+def _dense_solve(D, C, B):
+    """f64 dense reference X for a batched chain."""
+    out = []
+    for j in range(D.shape[0]):
+        A = _np_dense(np.float64(D[j]), np.float64(C[j]))
+        x = np.linalg.solve(A, np.float64(B[j]).reshape(A.shape[0], -1))
+        out.append(x.reshape(B.shape[1:]))
+    return np.stack(out)
+
+
+# ---------------------------------------------------------------------------
+# numerical parity: chain vs dense / SciPy
+# ---------------------------------------------------------------------------
+
+
+class TestParity:
+    @pytest.mark.parametrize("nblocks,b", [(2, 4), (4, 8), (6, 4)])
+    def test_posv_matches_dense_xla_f64(self, nblocks, b):
+        rng = np.random.default_rng(20)
+        D, C, B = _chain(rng, 2, nblocks, b, 3)
+        X, info = blocktri.posv(jnp.asarray(D), jnp.asarray(C),
+                                jnp.asarray(B), impl="xla")
+        np.testing.assert_array_equal(np.asarray(info), 0)
+        np.testing.assert_allclose(np.asarray(X), _dense_solve(D, C, B),
+                                   rtol=0, atol=1e-11)
+
+    @pytest.mark.parametrize("dtype,tol", [(jnp.float32, 5e-5),
+                                           (jnp.bfloat16, 5e-2)])
+    def test_posv_matches_dense_pallas(self, dtype, tol):
+        rng = np.random.default_rng(21)
+        D, C, B = _chain(rng, 2, 4, 8, 2)
+        X, info = blocktri.posv(
+            jnp.asarray(D, dtype), jnp.asarray(C, dtype),
+            jnp.asarray(B, dtype), impl="pallas")
+        ref = _dense_solve(D, C, B)
+        np.testing.assert_array_equal(np.asarray(info), 0)
+        err = np.abs(np.float64(np.asarray(X)) - ref).max()
+        assert err < tol * np.abs(ref).max()
+
+    def test_factor_reconstructs_chain(self):
+        # L_i·L_iᵀ (+ W_i·W_iᵀ for i>0) rebuilds D_i, W_i·L_{i−1}ᵀ
+        # rebuilds C_i — the residual the bench factor gate computes
+        rng = np.random.default_rng(22)
+        D, C, B = _chain(rng, 1, 4, 4, 1)
+        L, Wt, info = blocktri.factor(jnp.asarray(D), jnp.asarray(C),
+                                      impl="xla")
+        assert int(info[0]) == 0
+        Ln = np.float64(np.asarray(L))[0]
+        Wn = np.float64(np.asarray(Wt))[0].transpose(0, 2, 1)  # W_i
+        for i in range(4):
+            rec = Ln[i] @ Ln[i].T + (Wn[i] @ Wn[i].T if i else 0.0)
+            np.testing.assert_allclose(rec, D[0, i], rtol=0, atol=1e-12)
+            if i:
+                np.testing.assert_allclose(Wn[i] @ Ln[i - 1].T, C[0, i],
+                                           rtol=0, atol=1e-12)
+
+    def test_solve_from_factor_matches_posv(self):
+        rng = np.random.default_rng(23)
+        D, C, B = _chain(rng, 2, 4, 4, 2)
+        Dj, Cj, Bj = jnp.asarray(D), jnp.asarray(C), jnp.asarray(B)
+        L, Wt, _ = blocktri.factor(Dj, Cj, impl="xla")
+        X2 = blocktri.solve(L, Wt, Bj, impl="xla")
+        X1, _ = blocktri.posv(Dj, Cj, Bj, impl="xla")
+        np.testing.assert_allclose(np.asarray(X2), np.asarray(X1),
+                                   rtol=0, atol=1e-13)
+
+    def test_assemble_matches_numpy(self):
+        rng = np.random.default_rng(24)
+        D, C, _ = _chain(rng, 2, 3, 4, 1)
+        A = blocktri.assemble(jnp.asarray(D), jnp.asarray(C))
+        ref = np.stack([_np_dense(D[j], C[j]) for j in range(2)])
+        np.testing.assert_array_equal(np.asarray(A), ref)
+
+    def test_posv_matches_scipy_banded(self):
+        # independent-library reference: SciPy's Hermitian banded solver
+        # on the lower band form (bandwidth 2b-1 for block size b)
+        sla = pytest.importorskip("scipy.linalg")
+        rng = np.random.default_rng(25)
+        nblocks, b = 4, 4
+        D, C, B = _chain(rng, 1, nblocks, b, 1)
+        A = _np_dense(D[0], C[0])
+        n, bw = A.shape[0], 2 * b - 1
+        ab = np.zeros((bw + 1, n))
+        for i in range(bw + 1):
+            ab[i, : n - i] = np.diag(A, -i)
+        ref = sla.solveh_banded(ab, B[0].reshape(n), lower=True)
+        X, _ = blocktri.posv(jnp.asarray(D), jnp.asarray(C),
+                             jnp.asarray(B), impl="xla")
+        np.testing.assert_allclose(np.asarray(X)[0].reshape(n), ref,
+                                   rtol=0, atol=1e-11)
+
+    @pytest.mark.slow
+    def test_long_chain_parity(self):
+        # nblocks=256 — the scan length regime the flagship bench runs;
+        # excluded from tier-1, covered by `make audit` wall-clock gates
+        rng = np.random.default_rng(26)
+        D, C, B = _chain(rng, 1, 256, 8, 1)
+        X, info = blocktri.posv(jnp.asarray(D), jnp.asarray(C),
+                                jnp.asarray(B), impl="xla")
+        assert int(info[0]) == 0
+        np.testing.assert_allclose(np.asarray(X), _dense_solve(D, C, B),
+                                   rtol=0, atol=1e-10)
+
+
+# ---------------------------------------------------------------------------
+# dispatch plumbing + validation
+# ---------------------------------------------------------------------------
+
+
+class TestDispatch:
+    def test_resolve_seg_divides(self):
+        assert blocktri.resolve_seg(16) == 8        # default, divides
+        assert blocktri.resolve_seg(12, 8) == 6     # decrement to divisor
+        assert blocktri.resolve_seg(4, 8) == 4      # clamp to nblocks
+        assert blocktri.resolve_seg(5, 8) == 5      # prime chain: itself
+        assert blocktri.resolve_seg(7, 3) == 1      # nothing divides -> 1
+
+    def test_f64_forced_pallas_falls_back_to_xla(self):
+        # the PR 6 dispatch-gate contract: the kernels compute f32, so a
+        # forced 'pallas' for f64 must not silently downgrade precision
+        assert blocktri._resolve_impl(
+            "pallas", jnp.dtype(jnp.float64), 8, 2, 4, None) == "xla"
+
+    def test_unknown_impl_rejected(self):
+        rng = np.random.default_rng(27)
+        D, C, B = _chain(rng, 1, 2, 4, 1)
+        with pytest.raises(ValueError, match="impl"):
+            blocktri.posv(jnp.asarray(D), jnp.asarray(C), jnp.asarray(B),
+                          impl="cuda")
+
+    def test_shape_validation(self):
+        D = jnp.zeros((1, 2, 4, 4))
+        with pytest.raises(ValueError, match="must match"):
+            blocktri.factor(D, jnp.zeros((1, 2, 4, 3)))
+        with pytest.raises(ValueError, match="batch, nblocks, b, b"):
+            blocktri.factor(jnp.zeros((2, 4, 4)), jnp.zeros((2, 4, 4)))
+        with pytest.raises(ValueError, match="riding"):
+            blocktri.posv(D, D, jnp.zeros((1, 3, 4, 1)))
+
+    def test_dead_first_coupling_ignored(self):
+        # C[:, 0] is dead weight by the chain contract; garbage there
+        # must produce the bitwise-identical solution
+        rng = np.random.default_rng(28)
+        D, C, B = _chain(rng, 1, 3, 4, 1)
+        C_bad = C.copy()
+        C_bad[:, 0] = 1e6 * rng.standard_normal((4, 4))
+        X0, i0 = blocktri.posv(jnp.asarray(D), jnp.asarray(C),
+                               jnp.asarray(B), impl="xla")
+        X1, i1 = blocktri.posv(jnp.asarray(D), jnp.asarray(C_bad),
+                               jnp.asarray(B), impl="xla")
+        np.testing.assert_array_equal(np.asarray(X0), np.asarray(X1))
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+
+
+# ---------------------------------------------------------------------------
+# breakdown info: global pivot convention + containment
+# ---------------------------------------------------------------------------
+
+
+class TestInfo:
+    def test_negative_pivot_exact_global_index(self):
+        # block 2 gets a clean-diagonal operand with one negative entry
+        # and a zeroed incoming coupling, so its Schur complement IS the
+        # block.  The pallas kernels' guarded in-kernel sweep reports the
+        # EXACT local pivot (3 -> global 2·b + 3); the xla path's LAPACK
+        # cholesky NaN-fills the whole failed block, so its post-hoc scan
+        # is block-exact only — both land inside block 2, never block 3
+        # (whose NaN-fed info min-combines away)
+        rng = np.random.default_rng(30)
+        nblocks, b = 4, 4
+        D, C, B = _chain(rng, 1, nblocks, b, 1, dtype=np.float32)
+        D[0, 2] = np.diag([1.0, 1.0, -5.0, 1.0]).astype(np.float32)
+        C[0, 2] = 0.0
+        _, _, info = blocktri.factor(jnp.asarray(D), jnp.asarray(C),
+                                     impl="pallas")
+        assert int(info[0]) == 2 * b + 3
+        _, _, info = blocktri.factor(jnp.asarray(np.float64(D)),
+                                     jnp.asarray(np.float64(C)),
+                                     impl="xla")
+        assert 2 * b + 1 <= int(info[0]) <= 3 * b
+
+    @pytest.mark.parametrize("impl,dtype", [("xla", np.float64),
+                                            ("pallas", np.float32)])
+    def test_nan_contained_to_one_batch_problem(self, impl, dtype):
+        # poison problem 1's block-1 diagonal: its info lands at or past
+        # that block (the exact pivot differs between impls — 0·NaN
+        # propagation through the sweeps — but the RANGE is pinned),
+        # while problem 0 stays healthy and correct
+        rng = np.random.default_rng(31)
+        nblocks, b = 4, 8
+        D, C, B = _chain(rng, 2, nblocks, b, 2, dtype=dtype)
+        D[1, 1, 0, 0] = np.nan
+        X, info = blocktri.posv(jnp.asarray(D), jnp.asarray(C),
+                                jnp.asarray(B), impl=impl)
+        info = np.asarray(info)
+        assert info[0] == 0
+        assert b + 1 <= info[1] <= nblocks * b + 1
+        X0 = np.float64(np.asarray(X)[0])
+        ref = _dense_solve(D[:1], C[:1], B[:1])[0]
+        tol = 1e-11 if dtype == np.float64 else 5e-5
+        assert np.abs(X0 - ref).max() < tol * np.abs(ref).max()
+
+    def test_combine_block_infos_first_pivot_wins(self):
+        start = jnp.zeros((1,), jnp.int32)
+        tails = [(0, 4, jnp.array([0])), (4, 4, jnp.array([5])),
+                 (8, 4, jnp.array([2]))]
+        # block at offset 4 reports the off-diagonal sentinel (w=nw+1 ->
+        # global n+1=13); block at offset 8 a true pivot (global 10) —
+        # any pivot <= n ranks above the sentinel
+        assert int(detect.combine_block_infos(start, tails, 12)[0]) == 10
+
+    def test_combine_block_infos_sentinel_alone(self):
+        start = jnp.zeros((1,), jnp.int32)
+        tails = [(4, 4, jnp.array([5]))]
+        assert int(detect.combine_block_infos(start, tails, 12)[0]) == 13
+
+
+# ---------------------------------------------------------------------------
+# serve padding contract
+# ---------------------------------------------------------------------------
+
+
+class TestPadding:
+    def test_appended_chain_blocks_are_bitwise_inert(self):
+        # same b, nblocks 3 -> 4: the sequential chain never feeds
+        # trailing identity blocks back, so the cropped solution is
+        # BITWISE the unpadded one (the _pad_blocktri contract)
+        rng = np.random.default_rng(32)
+        D, C, B = _chain(rng, 1, 3, 4, 2)
+        A = jnp.asarray(np.stack([D[0], C[0]]))
+        Bj = jnp.asarray(B[0])
+        bucket = batching.Bucket("posv_blocktri", "float64",
+                                 (2, 4, 4, 4), (4, 4, 2), 2)
+        pa, pb = batching.pad_operands("posv_blocktri", A, Bj, bucket)
+        Xp, ip = blocktri.posv(pa[None, 0], pa[None, 1], pb[None],
+                               impl="xla")
+        X0, i0 = blocktri.posv(A[None, 0], A[None, 1], Bj[None],
+                               impl="xla")
+        Xc = batching.crop("posv_blocktri", Xp[0], A.shape, Bj.shape)
+        np.testing.assert_array_equal(np.asarray(Xc), np.asarray(X0)[0])
+        # the identity tail solves to exact zeros, and info stays clean
+        np.testing.assert_array_equal(np.asarray(Xp)[0, 3:], 0.0)
+        assert int(ip[0]) == int(i0[0]) == 0
+
+    def test_block_pad_embeds_identity_tail(self):
+        # b 3 -> 4 AND nblocks 3 -> 4: diag(D_i, I) embed, zero-filled
+        # couplings/RHS — tight (not bitwise: the contraction length
+        # changes) and the padded operand stays a valid SPD chain
+        rng = np.random.default_rng(33)
+        D, C, B = _chain(rng, 1, 3, 3, 1)
+        A = jnp.asarray(np.stack([D[0], C[0]]))
+        Bj = jnp.asarray(B[0])
+        bucket = batching.Bucket("posv_blocktri", "float64",
+                                 (2, 4, 4, 4), (4, 4, 1), 2)
+        pa, pb = batching.pad_operands("posv_blocktri", A, Bj, bucket)
+        # real blocks completed to diag(D_i, I), appended block pure I
+        np.testing.assert_array_equal(np.asarray(pa)[0, 0, 3, :],
+                                      np.eye(4)[3])
+        np.testing.assert_array_equal(np.asarray(pa)[0, 3], np.eye(4))
+        np.testing.assert_array_equal(np.asarray(pa)[1, 3], 0.0)
+        Xp, ip = blocktri.posv(pa[None, 0], pa[None, 1], pb[None],
+                               impl="xla")
+        assert int(ip[0]) == 0
+        Xc = batching.crop("posv_blocktri", Xp[0], A.shape, Bj.shape)
+        np.testing.assert_allclose(np.asarray(Xc),
+                                   _dense_solve(D, C, B)[0],
+                                   rtol=0, atol=1e-12)
+
+    def test_fill_problem_is_identity_chain(self):
+        bucket = batching.Bucket("posv_blocktri", "float64",
+                                 (2, 4, 4, 4), (4, 4, 2), 2)
+        fa, fb = batching.fill_problem(bucket)
+        np.testing.assert_array_equal(np.asarray(fa)[0],
+                                      np.broadcast_to(np.eye(4), (4, 4, 4)))
+        np.testing.assert_array_equal(np.asarray(fa)[1], 0.0)
+        X, info = blocktri.posv(fa[None, 0], fa[None, 1], fb[None],
+                                impl="xla")
+        np.testing.assert_array_equal(np.asarray(X), 0.0)
+        assert int(info[0]) == 0
+
+
+# ---------------------------------------------------------------------------
+# serve engine: bucketing, zero-recompile, ops counter, config hash
+# ---------------------------------------------------------------------------
+
+
+class TestServeBlocktri:
+    def test_engine_matches_dense(self):
+        rng = np.random.default_rng(34)
+        D, C, B = _chain(rng, 1, 2, 3, 1)
+        eng = SolveEngine(cfg=BT_CFG)
+        r = eng.solve("posv_blocktri", np.stack([D[0], C[0]]), B[0])
+        assert r.ok and r.batched and r.bucket is not None
+        np.testing.assert_allclose(np.asarray(r.x),
+                                   _dense_solve(D, C, B)[0],
+                                   rtol=0, atol=1e-10)
+
+    def test_same_bucket_zero_recompile(self):
+        # (nblocks=2, b=3) and (nblocks=2, b=4) land in the same
+        # (2, 4)-bucket: one compile, then steady-state hits
+        rng = np.random.default_rng(35)
+        eng = SolveEngine(cfg=BT_CFG)
+        for b in (3, 4):
+            D, C, B = _chain(rng, 1, 2, b, 1)
+            r = eng.solve("posv_blocktri", np.stack([D[0], C[0]]), B[0])
+            assert r.ok
+        c = eng.cache_stats()
+        assert (c["hits"], c["misses"]) == (1, 1)
+        assert eng.stats.ops["posv_blocktri"] == 2
+
+    def test_submit_validation(self):
+        eng = SolveEngine(cfg=BT_CFG)
+        with pytest.raises(ValueError, match="diagonal blocks"):
+            eng.submit("posv_blocktri", np.zeros((3, 2, 4, 4)),
+                       np.zeros((2, 4, 1)))
+        with pytest.raises(ValueError, match="riding"):
+            eng.submit("posv_blocktri", np.zeros((2, 2, 4, 4)),
+                       np.zeros((2, 3, 1)))
+
+    def test_blocktri_ladders_join_config_hash(self):
+        e1 = SolveEngine(cfg=BT_CFG)
+        e2 = SolveEngine(cfg=ServeConfig(
+            buckets=BT_CFG.buckets, rows_buckets=BT_CFG.rows_buckets,
+            nrhs_buckets=BT_CFG.nrhs_buckets, max_batch=BT_CFG.max_batch,
+            max_delay_s=BT_CFG.max_delay_s,
+            nblocks_buckets=BT_CFG.nblocks_buckets,
+            block_buckets=(4, 16),
+        ))
+        assert e1._cfg_hash != e2._cfg_hash
+
+    def test_oversize_chain_routes_single(self):
+        # nblocks beyond the ladder: unbatched single-problem route,
+        # still correct
+        rng = np.random.default_rng(36)
+        D, C, B = _chain(rng, 1, 6, 3, 1)
+        eng = SolveEngine(cfg=BT_CFG)
+        r = eng.solve("posv_blocktri", np.stack([D[0], C[0]]), B[0])
+        assert r.ok and not r.batched and r.bucket is None
+        np.testing.assert_allclose(np.asarray(r.x),
+                                   _dense_solve(D, C, B)[0],
+                                   rtol=0, atol=1e-10)
+
+
+# ---------------------------------------------------------------------------
+# ledger seam: exemption-with-validation for bench:blocktri records
+# ---------------------------------------------------------------------------
+
+
+def _bt_measured(**over):
+    m = {"metric": "blocktri_tflops", "value": 1.5, "nblocks": 4,
+         "block": 8, "n": 32, "batch": 2, "nrhs": 1, "impl": "xla",
+         "speedup": 40.0}
+    m.update(over)
+    return m
+
+
+class TestLedgerSeam:
+    def test_valid_record_passes_diff(self):
+        rec = ledger.record("bench:blocktri", ledger.manifest(),
+                            measured=_bt_measured())
+        assert ledger.diff([rec], [rec]) == []
+
+    def test_validate_flags_geometry_mismatch(self):
+        probs = ledger.validate_blocktri_measured(_bt_measured(n=33))
+        assert any("nblocks*block" in p for p in probs)
+
+    def test_malformed_record_is_incompatible(self):
+        rec = ledger.record("bench:blocktri", ledger.manifest(),
+                            measured=_bt_measured(impl="cuda"))
+        with pytest.raises(ledger.LedgerIncompatible, match="blocktri"):
+            ledger.diff([rec], [rec])
+
+    def test_latency_metric_also_validated(self):
+        m = _bt_measured(metric="blocktri_latency", nblocks=0)
+        rec = ledger.record("bench:blocktri", ledger.manifest(), measured=m)
+        with pytest.raises(ledger.LedgerIncompatible, match="nblocks"):
+            ledger.diff([rec], [rec])

@@ -6,8 +6,8 @@
 PY ?= python
 
 .PHONY: all test benchmarking bench-explicit bench-small bench-blocktri \
-	tune audit lint robust serve-smoke serve-bench serve-replicas native \
-	clean
+	bench-update tune audit lint robust serve-smoke serve-bench \
+	serve-replicas native clean
 
 all: test
 
@@ -66,6 +66,28 @@ bench-blocktri:
 		--nblocks 8 --block 16 --batch 4 --nrhs 2 --latency --calls 8 \
 		--validate --ledger bench_blocktri.jsonl
 
+# online factor-maintenance gate (docs/PERF.md round 12): rank-k Cholesky
+# update at the flagship serve shape (n=1024, k=16) vs refactor-from-
+# resident-state — the honest cache-less alternative: the server already
+# holds R, so the baseline reassembles S = RᵀR + VVᵀ and refactors
+# (docs/PERF.md spells out why vs client-shipped-A the ratio would be
+# smaller).  Gated at >= 5x per-problem wall-clock with f64-NumPy-side
+# update AND downdate residuals held to tolerance, plus a 50-request
+# serve smoke with mixed chol_update/posv_cached traffic gated on
+# residency hit-rate >= 0.9 and zero steady-state recompiles
+# (serve/factorcache.py).  obs serve-report re-gates the ledger record's
+# factor_cache block — fails loudly if no record carries it.
+bench-update:
+	rm -f bench_update.jsonl
+	$(PY) -m capital_tpu.bench update --platform cpu --n 1024 --k 16 \
+		--batch 2 --dtype float32 --iters 5 --validate \
+		--min-speedup 5 --min-hit-rate 0.9 --ledger bench_update.jsonl
+	$(PY) -m capital_tpu.obs serve-report bench_update.jsonl \
+		--min-residency-hit-rate 0.85
+# (0.85, not the driver's 0.9: the record's factor_cache block carries
+# LIFETIME counters, so the engine's per-bucket warmup lookups dilute the
+# steady-state 0.92 the driver gates on delta counters)
+
 # model-vs-compiled drift gate on the flagship configs (docs/OBSERVABILITY.md);
 # compile-only — runs in CI without a TPU (exit non-zero on drift).  The
 # bench.trace step is the phase-attribution gate: it decomposes a real
@@ -75,7 +97,7 @@ bench-blocktri:
 # through obs trace-report — the same double-entry discipline as lint.
 # The generous 0.995 bound absorbs CPU-interpret emulation; what it pins
 # is that attribution works end to end.
-audit: serve-smoke serve-bench serve-replicas bench-blocktri lint
+audit: serve-smoke serve-bench serve-replicas bench-blocktri bench-update lint
 	$(PY) -m capital_tpu.obs audit cholinv --n 4096 --platform cpu
 	$(PY) -m capital_tpu.obs audit cacqr --m 16384 --n 512 --platform cpu
 	$(PY) -m capital_tpu.obs robust-gate --platform cpu
@@ -175,5 +197,5 @@ clean:
 	rm -rf autotune_out .pytest_cache bench_explicit.jsonl serve_smoke.jsonl \
 		lint_report.jsonl bench_small.jsonl serve_bench.jsonl serve_cache \
 		bench_trace.jsonl serve_replicas.jsonl serve_replicas_cache \
-		bench_blocktri.jsonl
+		bench_blocktri.jsonl bench_update.jsonl
 	find . -name __pycache__ -type d -exec rm -rf {} +

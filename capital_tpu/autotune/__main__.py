@@ -1,5 +1,5 @@
-"""CLI: python -m capital_tpu.autotune {cholinv,cacqr,trsm,small,blocktri}
-[flags]."""
+"""CLI: python -m capital_tpu.autotune
+{cholinv,cacqr,trsm,small,blocktri,update} [flags]."""
 
 from __future__ import annotations
 
@@ -11,7 +11,7 @@ import jax
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(prog="capital_tpu.autotune")
     p.add_argument("alg", choices=["cholinv", "cacqr", "trsm", "small",
-                                   "blocktri"])
+                                   "blocktri", "update"])
     p.add_argument("--n", type=int, default=4096)
     p.add_argument("--m", type=int, default=65536)
     p.add_argument("--dtype", default="bfloat16")
@@ -94,6 +94,20 @@ def main(argv=None) -> None:
         "--blocks", type=int, nargs="+", default=None,
         help="small/blocktri: column-block unroll axis for the pallas "
         "impls (0 = pick_block default)",
+    )
+    p.add_argument(
+        "--rank", type=int, default=16,
+        help="update: rank k of the swept chol_update/chol_downdate panel",
+    )
+    p.add_argument(
+        "--update-op", default="chol_update",
+        choices=["chol_update", "chol_downdate"],
+        help="update: which maintenance op's bucket executables to sweep",
+    )
+    p.add_argument(
+        "--panels", type=int, nargs="+", default=None,
+        help="update: panel-width axis for the xla J-orthogonal impl "
+        "(resolve_panel snaps each to a divisor of --n; 0 = auto)",
     )
     p.add_argument(
         "--nblocks", type=int, default=8,
@@ -312,6 +326,41 @@ def main(argv=None) -> None:
             nrhs=args.nrhs, dtype=dtype, out_dir=args.out,
             occupancy=args.occupancy, calls=args.calls,
             checkpoint=args.resume, ledger=args.ledger, **space,
+        )
+    elif args.alg == "update":
+        # latency-mode sweep for ONE chol_update/chol_downdate bucket:
+        # impl x block-unroll (pallas) / panel (xla) at fixed occupancy
+        for flag, given in (
+            ("--grids", "grids" in space),
+            ("--splits", bool(args.splits)),
+            ("--policies", bool(args.policies)),
+            ("--tail-depths", bool(args.tail_depths)),
+            ("--top-k", args.top_k != 0),
+            ("--modes", bool(args.modes)),
+            ("--bc", bool(args.bc)),
+            ("--buckets", bool(args.buckets)),
+            ("--segs", bool(args.segs)),
+        ):
+            if given:
+                p.error(
+                    f"{flag} is not an update sweep axis (impl x "
+                    "block/panel only)"
+                )
+        space = {}
+        if args.impls:
+            if any(i in ("vmap", "pallas_split") for i in args.impls):
+                p.error("update impls are 'xla' and 'pallas' only")
+            space["impls"] = tuple(args.impls)
+        if args.blocks:
+            space["blocks"] = tuple(args.blocks)
+        if args.panels:
+            space["panels"] = tuple(args.panels)
+        grid = Grid.square(c=1, devices=dev[:1])
+        res = sweep.tune_update(
+            grid, args.n, args.rank, batch=args.batch, op=args.update_op,
+            dtype=dtype, out_dir=args.out, occupancy=args.occupancy,
+            calls=args.calls, checkpoint=args.resume, ledger=args.ledger,
+            **space,
         )
     else:
         grid = Grid.flat(devices=dev)

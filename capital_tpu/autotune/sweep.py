@@ -855,6 +855,125 @@ def tune_blocktri(
     )
 
 
+def update_small_space(
+    n: int,
+    k: int,
+    V,
+    dtype,
+    op: str = "chol_update",
+    impls: Iterable[str] = ("xla", "pallas"),
+    blocks: Iterable[int] = (0,),
+    panels: Iterable[int] = (0,),
+):
+    """impl x block-unroll (pallas) / panel-width (xla) for the rank-k
+    factor-maintenance kernels (ops/update_small): the serve dispatch
+    alternatives for the chol_update / chol_downdate buckets — the masked
+    hyperbolic-rotation pallas sweep (knob: in-kernel column unroll
+    `block`, the batched_small convention) against the blocked
+    J-orthogonal XLA panel scan (knob: `panel`, rows factored per
+    J-Cholesky step).  Each impl sweeps ITS OWN knob so the product stays
+    non-degenerate (the other impl ignores it).  `V` rides as a closure
+    so the swept operand stays the single resident-factor batch R the
+    run_sweep manifest and checkpoint key expect."""
+    from capital_tpu.ops import batched_small, update_small
+
+    if op not in ("chol_update", "chol_downdate"):
+        raise ValueError(
+            f"update_small_space: op must be 'chol_update' or "
+            f"'chol_downdate', got {op!r}"
+        )
+    fn = getattr(update_small, op)
+    prec = None if jnp.dtype(dtype).itemsize < 4 else "highest"
+    for impl in impls:
+        if impl not in ("xla", "pallas"):
+            raise ValueError(
+                f"update_small_space: impl must be 'xla' or 'pallas', "
+                f"got {impl!r}"
+            )
+        if impl == "xla":
+            for pan in panels:
+                pan_eff = update_small.resolve_panel(n, k, pan)
+
+                def step(r, pan=pan):
+                    return fn(r, V, panel=pan, precision=prec, impl="xla")
+
+                yield (
+                    f"xla_p{pan_eff}",
+                    {"impl": "xla", "panel": pan_eff},
+                    step,
+                )
+            continue
+        for blk in blocks:
+            blk_eff = blk or batched_small.pick_block(n)
+
+            def step(r, blk=blk):
+                return fn(r, V, block=blk, precision=prec, impl="pallas")
+
+            yield (
+                f"pallas_b{blk_eff}",
+                {"impl": "pallas", "block": blk_eff},
+                step,
+            )
+
+
+def tune_update(
+    grid: Grid,
+    n: int,
+    k: int,
+    batch: int = 8,
+    op: str = "chol_update",
+    dtype=jnp.float32,
+    out_dir: str = "autotune_out",
+    occupancy: float = 1.0,
+    calls: int = 32,
+    warmup: int = 3,
+    checkpoint: bool = False,
+    ledger: str | None = None,
+    **space,
+) -> list[SweepResult]:
+    """Latency-mode sweep for ONE chol_update / chol_downdate serve
+    bucket: impl x block-unroll/panel measured by per-call p99 wall time
+    (latency_measure) at fixed batch occupancy — the serving objective
+    (a residency update sits on a request's critical path), not peak
+    TFLOP/s.  The operand batch carries ``round(occupancy * batch)``
+    real resident factors and identity fill for the tail (identity R
+    with a zero V panel — exactly batching.pad_operands' fixed-point pad,
+    so fill rotations are t = 0 no-ops); a downdate sweep downdates a
+    panel the real factors provably contain (V scaled well inside the
+    smallest eigenvalue), so no swept config ever measures the breakdown
+    path."""
+    import numpy as np
+
+    if not 0.0 < occupancy <= 1.0:
+        raise ValueError(f"tune_update: occupancy {occupancy} outside (0, 1]")
+    real = max(1, round(occupancy * batch))
+    rng = np.random.default_rng(7)
+    X = rng.standard_normal((batch, n, n))
+    A = X @ X.transpose(0, 2, 1) / n + 3.0 * np.eye(n)
+    R = np.linalg.cholesky(A).transpose(0, 2, 1)
+    R[real:] = np.eye(n)
+    # 0.1/sqrt(n) scaling keeps ||VVᵀ|| well under the 3I shift: the
+    # downdate stays deep inside SPD territory for every real problem
+    V = 0.1 / np.sqrt(n) * rng.standard_normal((batch, n, k))
+    V[real:] = 0.0  # fill factors: zero panel -> t = 0 no-op rotations
+    R = jax.block_until_ready(jnp.asarray(R, dtype))
+    V = jax.block_until_ready(jnp.asarray(V, dtype))
+    return run_sweep(
+        "update",
+        update_small_space(n, k, V, dtype, op=op, **space),
+        R,
+        out_dir,
+        dtype=dtype,
+        checkpoint=checkpoint,
+        key_extra={
+            **_grid_key(grid), "op": op, "n": n, "k": k, "batch": batch,
+            "occupancy": occupancy, "calls": calls,
+        },
+        ledger=ledger,
+        measure=latency_measure(calls=calls, warmup=warmup),
+    )
+
+
 def tune_trsm(
     grid: Grid,
     n: int,

@@ -1093,6 +1093,210 @@ def blocktri(args) -> dict:
     return rec
 
 
+def update(args) -> dict:
+    """Bench online factor maintenance (ops/update_small): measured rank-k
+    chol_update against the REFACTOR-FROM-RESIDENT-STATE baseline — the
+    cache-less server's only alternative on the factor-residency wire
+    protocol (docs/SERVING.md: clients ship the rank-k panel V, never A,
+    so serving the same request without a resident factor means
+    reassembling S = RᵀR + VVᵀ and running a fresh potrf).  That framing
+    is load-bearing for the speedup gate and stated with the number
+    everywhere it lands (docs/PERF.md round 12): against a
+    client-shipped-A refactor (one potrf, no reassembly) the rank-k
+    update's algorithmic edge is k/n-bounded and this 1-core CPU rig
+    measures ~3x at n=1024, k=16 — the protocol baseline is the honest
+    serving comparison, not the flattering one.
+
+    --validate adds f64-NumPy-side residual gates (the bench-blocktri
+    discipline): ‖R₊ᵀR₊ − (A + VVᵀ)‖_F/‖·‖_F for the update, the same
+    for a downdate back to A, and zero info flags on both sweeps.
+
+    --min-hit-rate additionally runs the 50-request serve smoke: mixed
+    chol_update / posv_cached traffic over a handful of tokens through a
+    real SolveEngine, gating residency hit-rate >= the floor AND zero
+    steady-state executable recompiles (residency is host-side state, so
+    factor traffic must never recompile)."""
+    from capital_tpu.ops import update_small
+
+    dtype = jnp.dtype(args.dtype)
+    grid = Grid.square(c=1, devices=jax.devices()[:1])
+    prec = _precision(args, dtype)
+    n, k, batch = args.n, args.k, args.batch
+    if k > n:
+        sys.exit(f"update: rank --k {k} exceeds --n {n}")
+    impl = args.impl  # auto/pallas/xla, the blocktri flag; update_small
+    # resolves 'auto' per shape (pallas only inside the small-N envelope)
+
+    import numpy as np
+
+    rng = np.random.default_rng(11)
+    X = rng.standard_normal((batch, n, n))
+    A = (X @ X.transpose(0, 2, 1) / n + 3.0 * np.eye(n)).astype(np.float64)
+    R0 = np.linalg.cholesky(A).transpose(0, 2, 1)
+    V0 = (0.1 / np.sqrt(n)) * rng.standard_normal((batch, n, k))
+    Rj = jax.block_until_ready(jnp.asarray(R0, dtype))
+    Vj = jax.block_until_ready(jnp.asarray(V0, dtype))
+
+    fn = jax.jit(lambda r, v: update_small.chol_update(
+        r, v, precision=prec, impl=impl))
+    dn = jax.jit(lambda r, v: update_small.chol_downdate(
+        r, v, precision=prec, impl=impl))
+
+    if args.validate:
+        R1, info1 = jax.block_until_ready(fn(Rj, Vj))
+        bad = int(jnp.sum(info1 != 0))
+        if bad:
+            sys.exit(f"validation failed: {bad} update(s) report info != 0")
+        R1n = np.asarray(R1, np.float64)
+        Ap = A + V0 @ V0.transpose(0, 2, 1)
+        tol = _tolerance(dtype)
+        worst = max(
+            float(np.linalg.norm(R1n[i].T @ R1n[i] - Ap[i])
+                  / np.linalg.norm(Ap[i]))
+            for i in range(batch)
+        )
+        _gate("update_residual", worst, tol)
+        R2, info2 = jax.block_until_ready(dn(R1, Vj))
+        if int(jnp.sum(info2 != 0)):
+            sys.exit("validation failed: downdate of a just-updated factor "
+                     "reports info != 0")
+        R2n = np.asarray(R2, np.float64)
+        worst = max(
+            float(np.linalg.norm(R2n[i].T @ R2n[i] - A[i])
+                  / np.linalg.norm(A[i]))
+            for i in range(batch)
+        )
+        _gate("downdate_residual", worst, tol)
+
+    # the baseline the wire protocol forces on a cache-less server:
+    # reassemble S = RᵀR + VVᵀ (the operand only the factor encodes) and
+    # refactor from scratch — measured with the same per-call protocol
+    from capital_tpu.ops import lapack as lapack_mod
+
+    def refactor(r, v):
+        s = (jnp.einsum("bji,bjk->bik", r, r, precision=prec)
+             + jnp.einsum("bik,bjk->bij", v, v, precision=prec))
+        return jax.vmap(
+            lambda m: lapack_mod.potrf(m, uplo="U", with_info=True))(s)
+
+    base_fn = jax.jit(refactor)
+    calls = max(args.iters, 3)
+    samples = harness.latency_samples(
+        lambda: fn(Rj, Vj), calls=calls, warmup=3)
+    bsamples = harness.latency_samples(
+        lambda: base_fn(Rj, Vj), calls=calls, warmup=1)
+    # min-of-samples on BOTH sides: the speedup gate compares algorithms,
+    # not scheduler noise, and best-observed latency is the stable
+    # estimator of that on a shared CPU rig (mean would let one preempted
+    # baseline call flip the gate either way).
+    t = min(samples)
+    t_base = min(bsamples)
+    speedup = t_base / t
+    print(f"# speedup {speedup:.1f}x vs refactor-from-resident-state at "
+          f"n={n} k={k} (refactor {t_base / batch * 1e3:.2f} ms/problem, "
+          f"update {t / batch * 1e3:.3f} ms/problem)")
+
+    smoke = None
+    if args.min_hit_rate:
+        smoke = _update_serve_smoke(min(n, 256), min(k, 16), dtype,
+                                    ledger=args.ledger)
+        print(f"# serve smoke: {smoke['requests']} requests, residency "
+              f"hit_rate {smoke['hit_rate']:.3f}, "
+              f"{smoke['recompiles']} steady-state recompiles")
+
+    # useful flops (textbook ~2kn² per problem), not the masked-sweep
+    # executed count — comparable against the baseline's ~2n³ reassembly
+    flops = batch * 2.0 * k * n * n
+    rec = harness.report(
+        "update_speedup", t, flops, dtype, n=n, k=k, batch=batch,
+        impl=impl, grid=repr(grid), speedup=round(speedup, 2),
+        refactor_ms=round(t_base / batch * 1e3, 3),
+        update_ms=round(t / batch * 1e3, 4),
+        wall_ms={kk: round(v * 1e3, 4)
+                 for kk, v in harness.percentiles(samples).items()},
+        **({"serve_smoke": smoke} if smoke else {}),
+    )
+    cfg = {"op": "chol_update", "impl": impl, "n": n, "k": k}
+    gates = []
+    if args.min_speedup and speedup < args.min_speedup:
+        gates.append(
+            f"speedup gate failed: {speedup:.1f}x < {args.min_speedup}x vs "
+            f"refactor-from-resident-state at n={n} k={k}"
+        )
+    if smoke and smoke["hit_rate"] < args.min_hit_rate:
+        gates.append(
+            f"residency gate failed: hit_rate {smoke['hit_rate']:.3f} < "
+            f"{args.min_hit_rate}"
+        )
+    if smoke and smoke["recompiles"]:
+        gates.append(
+            f"zero-recompile gate failed: {smoke['recompiles']} executable "
+            "compiles during steady-state factor traffic"
+        )
+    _ledger_append(args, rec, name="update", grid=grid, dtype=dtype, cfg=cfg)
+    if gates:
+        sys.exit("; ".join(gates))
+    return rec
+
+
+def _update_serve_smoke(n: int, k: int, dtype, ledger=None) -> dict:
+    """The 50-request mixed-traffic residency smoke (bench-update gate):
+    seed a few tokens through posv_cached misses, then drive
+    chol_update / posv_cached hits against them through a real
+    SolveEngine.  Returns the delta counters the caller gates on —
+    hit_rate over THIS traffic (not engine lifetime) and executable
+    compiles after the one-time per-bucket warmup.  When `ledger` is
+    given, also appends the engine's serve:request_stats record (carrying
+    the LIFETIME factor_cache counter block, warmup lookups included) so
+    ``obs serve-report --min-residency-hit-rate`` has a record to gate."""
+    import numpy as np
+
+    from capital_tpu.serve.engine import ServeConfig, SolveEngine
+
+    rng = np.random.default_rng(13)
+    cfg = ServeConfig(buckets=(n,), rows_buckets=(4 * n,),
+                      nrhs_buckets=(min(4, k), k), max_batch=2,
+                      max_delay_s=0.0, oversize="reject")
+    eng = SolveEngine(cfg=cfg)
+    X = rng.standard_normal((n, n))
+    A = (X @ X.T / n + 3.0 * np.eye(n)).astype(dtype)
+    B = rng.standard_normal((n, min(4, k))).astype(dtype)
+    V = ((0.05 / np.sqrt(n))
+         * rng.standard_normal((n, k))).astype(dtype)
+    # warm every program the mix touches (the per-bucket one-time cost);
+    # everything after this line must hit the executable cache
+    for i in range(2):
+        assert eng.solve("posv_cached", A, B,
+                         factor_token=f"warm{i}").ok
+    assert eng.solve("chol_update", V, factor_token="warm0").ok
+    assert eng.solve("posv_cached", A, B, factor_token="warm1").ok
+    c0 = eng.cache_stats()["compiles"]
+    f0 = eng.factor_stats()
+    tokens = [f"tok{i}" for i in range(4)]
+    requests = 0
+    for tok in tokens:  # 4 seeding misses
+        assert eng.solve("posv_cached", A, B, factor_token=tok).ok
+        requests += 1
+    while requests < 50:  # 46 resident hits, mixed ops
+        tok = tokens[requests % len(tokens)]
+        if requests % 3 == 0:
+            r = eng.solve("chol_update", V, factor_token=tok)
+        else:
+            r = eng.solve("posv_cached", A, B, factor_token=tok)
+        assert r.ok, r.error
+        requests += 1
+    f1 = eng.factor_stats()
+    hits = f1["hits"] - f0["hits"]
+    lookups = hits + f1["misses"] - f0["misses"]
+    if ledger:
+        eng.emit_stats(ledger)
+    return {
+        "requests": requests,
+        "hit_rate": round(hits / lookups, 4),
+        "recompiles": eng.cache_stats()["compiles"] - c0,
+    }
+
+
 def posv(args):
     return _small_solve(args, "posv")
 
@@ -1112,6 +1316,7 @@ DRIVERS = {
     "posv": posv,
     "lstsq": lstsq,
     "blocktri": blocktri,
+    "update": update,
 }
 
 
@@ -1231,6 +1436,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="blocktri: fail the run when the measured per-problem "
         "speedup vs equal-n dense posv lands below this factor "
         "(the round-11 flagship gate: 25 at nblocks=64, block=128, f32)",
+    )
+    p.add_argument(
+        "--min-hit-rate", type=float, default=0.0,
+        help="update: run the 50-request mixed chol_update/posv_cached "
+        "serve smoke and fail below this residency hit-rate (the round-12 "
+        "gate: 0.9) or on any steady-state executable recompile",
     )
     p.add_argument(
         "--phase-attr", action="store_true",

@@ -21,7 +21,7 @@ import jax.numpy as jnp
 from capital_tpu.lint.program import ProgramTarget
 
 TARGET_NAMES = ("cholinv", "cacqr", "serve", "batched_small", "serve_sched",
-                "cholinv_fused", "blocktri")
+                "cholinv_fused", "blocktri", "update_small")
 
 
 def _grid():
@@ -168,6 +168,42 @@ def blocktri_target(
     )
 
 
+def update_small_target(
+    n: int = 64, k: int = 4, capacity: int = 8, dtype=jnp.float32,
+) -> ProgramTarget:
+    """The online factor-maintenance bucket program (ops/update_small
+    through api.batched, the executables serve/engine compiles for
+    chol_update / chol_downdate traffic): one rank-k update under
+    ``UP::update`` chained into the downdate back under ``UP::downdate``
+    — both phase tags under the phase-coverage rule, and the masked
+    hyperbolic-rotation sweep's pallas_call under cache-key hygiene.
+    Forced impl='pallas' (n=64 is inside the small-N envelope) so the
+    lint sees the kernel route serve routes on TPU regardless of the CPU
+    rig's resolution.  ``flops_audited=False``: the sweep flops execute
+    inside the interpreted ``pallas_call`` on the CPU rig, invisible to
+    XLA ``cost_analysis`` (same reasoning as batched_small_targets).  No
+    jit-level donation for the same interpret-rig reason — the engine's
+    donate_argnums=(0,) on the R operand is honored only by the compiled
+    TPU route."""
+    from capital_tpu.serve import api
+
+    dt = jnp.dtype(dtype)
+    r_sds = jax.ShapeDtypeStruct((capacity, n, n), dt)
+    v_sds = jax.ShapeDtypeStruct((capacity, n, k), dt)
+    up = api.batched("chol_update", impl="pallas")
+    dn = api.batched("chol_downdate", impl="pallas")
+
+    def step(r, v):
+        R1, i1 = up(r, v)
+        R2, i2 = dn(R1, v)
+        return R2, jnp.maximum(i1, i2)
+
+    return ProgramTarget(
+        name=f"update-small-b{capacity}-n{n}-k{k}", fn=step,
+        args=(r_sds, v_sds), flops_audited=False,
+    )
+
+
 def cholinv_fused_target(n: int = 512, dtype=jnp.float32) -> ProgramTarget:
     """The fused-recursion-tail cholinv program (CholinvConfig.
     tail_fuse_depth > 0): n=512 with bc=128 and depth 2 fuses the whole
@@ -256,6 +292,8 @@ def flagship_targets(names=None) -> list[ProgramTarget]:
             out.append(cholinv_fused_target())
         elif name == "blocktri":
             out.append(blocktri_target())
+        elif name == "update_small":
+            out.append(update_small_target())
         else:
             raise ValueError(
                 f"unknown lint target {name!r}; expected one of {TARGET_NAMES}"

@@ -102,12 +102,17 @@ def _resolve_impl(impl: str, dtype, b: int, k: int, seg: int,
     return impl
 
 
-def _combine(infos, nblocks: int, b: int):
+def _combine(infos, nblocks: int, b: int, offset: int = 0):
     """Per-block infos (batch, nblocks) local 0/k/b+1 -> global (batch,)
-    potrf status over n = nblocks·b (shared fused-tail convention)."""
-    n = nblocks * b
+    potrf status over n = offset + nblocks·b (shared fused-tail
+    convention).  `offset` shifts the blocks' diagonal positions — the
+    extend() path reports pivots relative to an already-factored prefix
+    of that many rows (0 keeps indices local to the appended blocks, the
+    serve route's choice: a per-prefix-length offset would be a fresh
+    traced constant per prefix, i.e. one recompile per chain length)."""
+    n = offset + nblocks * b
     start = jnp.zeros(infos.shape[:1], jnp.int32)
-    tails = [(i * b, b, infos[:, i]) for i in range(nblocks)]
+    tails = [(offset + i * b, b, infos[:, i]) for i in range(nblocks)]
     return detect.combine_block_infos(start, tails, n)
 
 
@@ -143,7 +148,7 @@ def _tri_solve(L, R, transpose: bool = False):
         L, R, left_side=True, lower=True, transpose_a=transpose)
 
 
-def _xla_factor_scan(D, C, precision):
+def _xla_factor_scan(D, C, precision, carry0=None):
     batch, nblocks, b, _ = D.shape
 
     def body(Lp, xs):
@@ -155,9 +160,10 @@ def _xla_factor_scan(D, C, precision):
         info = jax.vmap(detect.factor_info)(L)
         return L, (L, wt, info)
 
+    if carry0 is None:
+        carry0 = _eye_carry(batch, b, D.dtype)
     _, (Ls, Wts, infos) = jax.lax.scan(
-        body, _eye_carry(batch, b, D.dtype), (jnp.moveaxis(D, 1, 0),
-                                              jnp.moveaxis(C, 1, 0)))
+        body, carry0, (jnp.moveaxis(D, 1, 0), jnp.moveaxis(C, 1, 0)))
     return (jnp.moveaxis(Ls, 0, 1), jnp.moveaxis(Wts, 0, 1),
             jnp.moveaxis(infos, 0, 1))
 
@@ -203,7 +209,8 @@ def _xla_backward_scan(L, Wt, Y, precision):
 # --------------------------------------------------------------------------
 
 
-def _pallas_factor_scan(D, C, *, seg, block, precision, interpret):
+def _pallas_factor_scan(D, C, *, seg, block, precision, interpret,
+                        carry0=None):
     batch, nblocks, b, _ = D.shape
     nsteps = nblocks // seg
     Ds, Cs = _steps(D, nsteps, seg), _steps(C, nsteps, seg)
@@ -214,8 +221,9 @@ def _pallas_factor_scan(D, C, *, seg, block, precision, interpret):
             d, c, Lc, block=block, precision=precision, interpret=interpret)
         return L[:, -1], (L, Wt, info)
 
-    _, (Ls, Wts, infos) = jax.lax.scan(
-        body, _eye_carry(batch, b, D.dtype), (Ds, Cs))
+    if carry0 is None:
+        carry0 = _eye_carry(batch, b, D.dtype)
+    _, (Ls, Wts, infos) = jax.lax.scan(body, carry0, (Ds, Cs))
     return _unsteps(Ls), _unsteps(Wts), _unsteps(infos)
 
 
@@ -307,6 +315,49 @@ def factor(D, C, *, block: int = 0, seg: int = 0,
         else:
             L, Wt, infos = _xla_factor_scan(D, C, precision)
     return L, Wt, _combine(infos, nblocks, b)
+
+
+def extend(D, C, L_last, *, block: int = 0, seg: int = 0,
+           precision: str | None = "highest", impl: str = "auto",
+           interpret: bool | None = None, offset: int = 0):
+    """Append blocks to an ALREADY-FACTORED chain without refactoring the
+    prefix: the Schur recurrence is first-order in the diagonal factor, so
+    continuing it only needs `L_last` — the final (batch, b, b) diagonal
+    factor of the existing chain (ROADMAP item 4's streaming state-space
+    case; the serve `blocktri_extend` op, docs/SERVING.md "Factor
+    residency").
+
+    D/C are the (batch, nblocks, b, b) APPENDED blocks only.  Unlike
+    `factor()`, C[:, 0] is LIVE here — it couples the first appended block
+    to the prefix tail; a caller starting a fresh chain (L_last = I) must
+    zero it explicitly.  `offset` (static) shifts the returned info's
+    pivot indices by the prefix length; the default 0 keeps them local to
+    the appended blocks so one compiled program serves every prefix
+    length.
+
+    Returns (L, Wt, info) for the appended blocks in the `factor()`
+    representation — concatenating onto the prefix's (L, Wt) yields
+    bitwise the factor a full refactor of the whole chain would produce
+    (the recurrence is identical, step for step; tests/test_update.py
+    asserts it)."""
+    _check_chain(D, C, op="blocktri extend")
+    batch, nblocks, b, _ = D.shape
+    if L_last.shape != (batch, b, b):
+        raise ValueError(
+            f"blocktri extend: L_last must be (batch, b, b) = "
+            f"({batch}, {b}, {b}) riding D {D.shape}, got {L_last.shape}")
+    seg = resolve_seg(nblocks, seg)
+    impl = _resolve_impl(impl, D.dtype, b, b, seg, interpret)
+    with tracing.scope("UP::extend"):
+        tracing.emit(flops=batch * tracing.blocktri_chol_flops(nblocks, b))
+        if impl == "pallas":
+            L, Wt, infos = _pallas_factor_scan(
+                D, C, seg=seg, block=block, precision=precision,
+                interpret=interpret, carry0=L_last)
+        else:
+            L, Wt, infos = _xla_factor_scan(D, C, precision,
+                                            carry0=L_last)
+    return L, Wt, _combine(infos, nblocks, b, offset)
 
 
 def solve(L, Wt, B, *, block: int = 0, seg: int = 0,

@@ -215,6 +215,7 @@ def _serve_report(args) -> int:
                 or args.max_p99_ms_small is not None
                 or args.min_occupancy is not None
                 or args.max_queue_wait_ms is not None
+                or args.min_residency_hit_rate is not None
                 or args.min_replicas is not None
                 or args.aggregate)
     if not rows:
@@ -224,6 +225,7 @@ def _serve_report(args) -> int:
     failures = []
     small_seen = 0
     split_seen = 0
+    factor_seen = 0
     for i, r in enumerate(rows):
         rs = r["request_stats"]
         man = r.get("manifest") or {}
@@ -231,6 +233,12 @@ def _serve_report(args) -> int:
         lat = rs["latency_ms"]
         lat_small = rs.get("latency_ms_small")
         qwait = rs.get("queue_wait_ms")
+        fc = rs.get("factor_cache")
+        fc_note = (
+            f" factor_cache hits={fc['hits']} misses={fc['misses']} "
+            f"evictions={fc['evictions']} degrades={fc['downdate_degrades']} "
+            f"hit_rate={fc['hit_rate']:.3f}" if fc else ""
+        )
         small_note = (
             f" small requests={rs.get('requests_small', 0)} "
             f"p99={lat_small['p99']}" if lat_small else ""
@@ -256,7 +264,7 @@ def _serve_report(args) -> int:
             f"queue_max={rs['queue_depth_max']} "
             f"cache hits={cache['hits']} misses={cache['misses']} "
             f"hit_rate={cache['hit_rate']:.3f}"
-            + small_note + split_note + ops_note
+            + small_note + split_note + ops_note + fc_note
         )
         if (args.min_hit_rate is not None
                 and cache["hit_rate"] < args.min_hit_rate):
@@ -283,6 +291,17 @@ def _serve_report(args) -> int:
                 failures.append(
                     f"record #{i}: small-bucket p99 {lat_small['p99']}ms > "
                     f"{args.max_p99_ms_small}ms"
+                )
+        if fc is not None:
+            factor_seen += 1
+            if (args.min_residency_hit_rate is not None
+                    and fc["hit_rate"] < args.min_residency_hit_rate):
+                failures.append(
+                    f"record #{i}: factor-residency hit_rate "
+                    f"{fc['hit_rate']:.3f} < {args.min_residency_hit_rate} "
+                    "(tokens evicted under the byte budget, or clients "
+                    "updating factors that were never seeded — see "
+                    "docs/SERVING.md 'Factor residency')"
                 )
         if qwait is not None:
             split_seen += 1
@@ -356,6 +375,11 @@ def _serve_report(args) -> int:
                     f"aggregate hit_rate {merged['cache']['hit_rate']:.3f} "
                     f"< {args.min_hit_rate}"
                 )
+    if args.min_residency_hit_rate is not None and not factor_seen:
+        failures.append(
+            "--min-residency-hit-rate requested but no record carries a "
+            "factor_cache block (no factor-token traffic served?)"
+        )
     if args.max_queue_wait_ms is not None and not split_seen:
         failures.append(
             "--max-queue-wait-ms requested but no record carries a "
@@ -562,6 +586,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="gate: fail when any record's queue_wait_ms.p99 "
                         "exceeds this; fails loudly when no record carries "
                         "the queue-wait/device latency split")
+    s.add_argument("--min-residency-hit-rate", type=float, default=None,
+                   help="fail when any record's factor_cache.hit_rate "
+                   "(serve/factorcache.py residency counters) is below "
+                   "this; fails loudly when NO record carries the block")
     s.add_argument("--max-p99-ms-small", type=float, default=None,
                    help="gate the small-N bucket latency split separately: "
                         "fail when any record's latency_ms_small.p99 "

@@ -236,7 +236,15 @@ _REQ_STATS_CACHE = ("hits", "misses", "warmup_compiles", "hit_rate")
 #: per-op request counters (serve/stats.Collector.ops): every key must be a
 #: serve op this tooling knows (batching.OPS, inlined so obs never imports
 #: serve) — an unknown key means the producer and the tooling drifted apart.
-_REQ_STATS_OPS = ("posv", "lstsq", "inv", "posv_blocktri")
+_REQ_STATS_OPS = ("posv", "lstsq", "inv", "posv_blocktri",
+                  "chol_update", "chol_downdate", "posv_cached",
+                  "blocktri_extend")
+#: factor_cache counter block (serve/factorcache.FactorCache.stats):
+#: attached to request_stats only by engines that served factor-token
+#: traffic — records without it stay valid unchanged.
+_REQ_STATS_FACTOR_COUNTS = ("hits", "misses", "evictions", "installs",
+                            "released", "downdate_degrades", "entries",
+                            "bytes", "budget_bytes")
 
 
 def validate_request_stats(block) -> list[str]:
@@ -319,6 +327,35 @@ def validate_request_stats(block) -> list[str]:
             probs.append(
                 f"requests_small must be a non-negative int, got {rs!r}"
             )
+    # optional factor-residency counters (serve/factorcache.py, PR 12):
+    # present only on engines that served factor-token traffic
+    # (stats.snapshot attaches the block when lookups or installs
+    # happened); its gate is ``obs serve-report --min-residency-hit-rate``.
+    if "factor_cache" in block:
+        fc = block["factor_cache"]
+        if not isinstance(fc, dict):
+            probs.append(f"factor_cache must be an object, got {fc!r}")
+        else:
+            for key in _REQ_STATS_FACTOR_COUNTS:
+                v = fc.get(key)
+                if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                    probs.append(
+                        f"factor_cache.{key} must be a non-negative int, "
+                        f"got {v!r}"
+                    )
+            hr = fc.get("hit_rate")
+            if not isinstance(hr, (int, float)) or not 0.0 <= hr <= 1.0:
+                probs.append(
+                    f"factor_cache.hit_rate must be in [0, 1], got {hr!r}"
+                )
+            h, m = fc.get("hits"), fc.get("misses")
+            if (isinstance(h, int) and isinstance(m, int)
+                    and isinstance(hr, (int, float)) and h + m > 0
+                    and abs(hr - h / (h + m)) > 1e-6):
+                probs.append(
+                    f"factor_cache.hit_rate {hr!r} inconsistent with "
+                    f"hits={h} misses={m} (expected {h / (h + m):.6f})"
+                )
     # multi-replica tags (serve/router.py, PR 9): a per-replica record
     # carries replica_id; the router's aggregate record carries replicas
     # (how many snapshots merged) and replica_ids.  Single-engine records
@@ -503,6 +540,65 @@ def validate_blocktri_measured(measured) -> list[str]:
     return probs
 
 
+#: update impls the bench driver can report (ops/update_small.IMPLS).
+_UPDATE_IMPLS = ("auto", "pallas", "xla")
+
+
+def validate_update_measured(measured) -> list[str]:
+    """Schema problems of a bench:update_speedup measured block ([] =
+    valid) — the online factor-maintenance fields the update driver emits
+    (the n/k geometry, the update-vs-refactor speedup columns, and the
+    optional serve_smoke residency block).  Same exemption-with-validation
+    posture as request_stats / blocktri: diff() validates every record
+    whose metric starts with "update" (malformed -> LedgerIncompatible)
+    while the metric itself still compares normally — the value is
+    rate-shaped (TFLOP/s over the useful 2kn² flops), so a drop reads as
+    "slower" like every other bench row."""
+    if not isinstance(measured, dict):
+        return [f"measured is {type(measured).__name__}, expected object"]
+    probs = []
+    for key in ("n", "k", "batch"):
+        v = measured.get(key)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+            probs.append(f"{key} must be a positive int, got {v!r}")
+    if measured.get("impl") not in _UPDATE_IMPLS:
+        probs.append(
+            f"impl must be one of {_UPDATE_IMPLS}, "
+            f"got {measured.get('impl')!r}"
+        )
+    for key in ("speedup", "refactor_ms", "update_ms"):
+        v = measured.get(key)
+        if not isinstance(v, (int, float)) or isinstance(v, bool) or not v > 0:
+            probs.append(f"{key} must be a positive number, got {v!r}")
+    wm = measured.get("wall_ms")
+    if not isinstance(wm, dict):
+        probs.append(f"wall_ms must be an object, got {wm!r}")
+    else:
+        for p in _REQ_STATS_PCTS:
+            if not isinstance(wm.get(p), (int, float)):
+                probs.append(f"wall_ms.{p} missing or non-numeric")
+    # the serve residency smoke rides along only when the driver ran it
+    # (--min-hit-rate); absent blocks stay valid unchanged
+    if "serve_smoke" in measured:
+        sm = measured["serve_smoke"]
+        if not isinstance(sm, dict):
+            probs.append(f"serve_smoke must be an object, got {sm!r}")
+        else:
+            for key in ("requests", "recompiles"):
+                v = sm.get(key)
+                if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                    probs.append(
+                        f"serve_smoke.{key} must be a non-negative int, "
+                        f"got {v!r}"
+                    )
+            hr = sm.get("hit_rate")
+            if not isinstance(hr, (int, float)) or not 0.0 <= hr <= 1.0:
+                probs.append(
+                    f"serve_smoke.hit_rate must be in [0, 1], got {hr!r}"
+                )
+    return probs
+
+
 def _event_status(rec: dict) -> Optional[str]:
     """The robustness status of a record, when it carries one.
 
@@ -589,6 +685,14 @@ def diff(
             if probs:
                 raise LedgerIncompatible(
                     "malformed blocktri bench record: " + "; ".join(probs)
+                )
+        if isinstance(meas, dict) and str(
+            meas.get("metric", "")
+        ).startswith("update"):
+            probs = validate_update_measured(meas)
+            if probs:
+                raise LedgerIncompatible(
+                    "malformed update bench record: " + "; ".join(probs)
                 )
     a_by = {_key(r): r for r in a_recs}
     b_by = {_key(r): r for r in b_recs}

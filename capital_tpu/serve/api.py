@@ -59,7 +59,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from capital_tpu.models import blocktri, cholesky, qr
-from capital_tpu.ops import batched_small, lapack
+from capital_tpu.ops import batched_small, lapack, update_small
 from capital_tpu.parallel import summa
 from capital_tpu.utils import tracing
 
@@ -199,6 +199,103 @@ def _batched_blocktri(precision, impl: str):
     return f
 
 
+#: serve-wide impl vocabulary -> the two-impl modules' own ('vmap' means
+#: the pure-XLA route; 'pallas_split' collapses to 'pallas' — neither the
+#: update sweep nor the chain scan has a split form).
+_TWO_IMPL_MAP = {"auto": "auto", "pallas": "pallas",
+                 "pallas_split": "pallas", "vmap": "xla"}
+
+
+def _batched_update(op: str, precision, impl: str):
+    """chol_update / chol_downdate bucket program: (resident factor batch,
+    rank-k panel batch) -> (R', info).  Impl resolution (incl. the
+    f64-always-xla gate) lives in ops/update_small._resolve_impl and
+    reads only static shapes/dtypes — zero-recompile safe."""
+    mapped = _TWO_IMPL_MAP[impl]
+    fn = (update_small.chol_update if op == "chol_update"
+          else update_small.chol_downdate)
+
+    def f(r, v):
+        return fn(r, v, precision=precision, impl=mapped)
+
+    return f
+
+
+def _batched_posv_cached(precision, impl: str):
+    """Solve against a RESIDENT factor: (R, B) -> (X, info≡0).  No
+    factorization happens, so info is identically zero (a resident factor
+    was healthy when installed — landing refuses to install flagged
+    ones); the program is potrs alone, the whole point of residency."""
+    def pallas_f(r, b):
+        X = batched_small.potrs(r, b, uplo="U", precision=precision)
+        return X, jnp.zeros(r.shape[0], jnp.int32)
+
+    def vmap_f(r, b):
+        with tracing.scope("serve::solve"):
+            X = jax.vmap(lambda rr, bb: lapack.potrs(rr, bb, uplo="U"))(r, b)
+        return X, jnp.zeros(r.shape[0], jnp.int32)
+
+    if impl == "vmap":
+        return vmap_f
+    if impl in ("pallas", "pallas_split"):
+        return lambda r, b: (
+            pallas_f(r, b) if batched_small.dtype_capable(r.dtype)
+            else vmap_f(r, b))
+
+    def auto(r, b):
+        pick = batched_small.default_impl("posv", r.shape, b.shape, r.dtype)
+        return vmap_f(r, b) if pick == "vmap" else pallas_f(r, b)
+
+    return auto
+
+
+def _batched_posv_cached_miss(precision, impl: str):
+    """The residency-miss (seeding) program: full (A, B) operands, THREE
+    outputs (X, R, info) so landing can install the fresh factor under
+    the request's token — a posv that also hands back its factor.  Priced
+    as a full refactor (the cost-model point of the residency hit-rate
+    gate)."""
+    def pallas_f(a, b):
+        R, info = batched_small.potrf(a, uplo="U", precision=precision)
+        X = batched_small.potrs(R, b, uplo="U", precision=precision)
+        return X, R, info
+
+    def one_vmap(a, b):
+        with tracing.scope("serve::solve"):
+            R, info = lapack.potrf(a, uplo="U", with_info=True)
+            return lapack.potrs(R, b, uplo="U"), R, info
+
+    vmap_f = jax.vmap(one_vmap)
+    if impl == "vmap":
+        return vmap_f
+    if impl in ("pallas", "pallas_split"):
+        return lambda a, b: (
+            pallas_f(a, b) if batched_small.dtype_capable(a.dtype)
+            else vmap_f(a, b))
+
+    def auto(a, b):
+        pick = batched_small.default_impl("posv", a.shape, b.shape, a.dtype)
+        return vmap_f(a, b) if pick == "vmap" else pallas_f(a, b)
+
+    return auto
+
+
+def _batched_extend(precision, impl: str):
+    """The chain-extension bucket program: (appended chain packing
+    (batch, 2, nblocks, b, b), resident carry (batch, b, b)) -> (stacked
+    [L; Wt] (batch, 2, nblocks, b, b), info).  C[:, 0] arrives LIVE (the
+    coupling into the prefix tail; the engine zeroes it host-side for
+    fresh-token seeds, so ONE compiled program serves both cases)."""
+    mapped = _TWO_IMPL_MAP[impl]
+
+    def f(a, carry):
+        L, Wt, info = blocktri.extend(a[:, 0], a[:, 1], carry,
+                                      precision=precision, impl=mapped)
+        return jnp.stack([L, Wt], axis=1), info
+
+    return f
+
+
 def batched(op: str, precision: str | None = "highest",
             impl: str = "auto"):
     """The function the engine AOT-compiles for one bucket: maps the fixed
@@ -218,6 +315,14 @@ def batched(op: str, precision: str | None = "highest",
         )
     if op == "posv_blocktri":
         return _batched_blocktri(precision, impl)
+    if op in ("chol_update", "chol_downdate"):
+        return _batched_update(op, precision, impl)
+    if op == "posv_cached":
+        return _batched_posv_cached(precision, impl)
+    if op == "posv_cached_miss":
+        return _batched_posv_cached_miss(precision, impl)
+    if op == "blocktri_extend":
+        return _batched_extend(precision, impl)
     if impl == "vmap":
         return _batched_vmap(op, precision)
     if impl in ("pallas", "pallas_split"):

@@ -31,7 +31,18 @@ import jax.numpy as jnp
 from capital_tpu.ops import masking
 from capital_tpu.utils import tracing
 
-OPS = ("posv", "lstsq", "inv", "posv_blocktri")
+OPS = ("posv", "lstsq", "inv", "posv_blocktri",
+       "chol_update", "chol_downdate", "posv_cached", "blocktri_extend")
+
+#: ops that require a resident factor (engine.submit factor_token=...).
+FACTOR_OPS = ("chol_update", "chol_downdate", "posv_cached",
+              "blocktri_extend")
+
+#: engine-internal bucket op: a posv_cached whose token was NOT resident
+#: rides the full (A, B) operands through a 3-output refactor program
+#: (X, R, info) so landing can install R — the seeding route, priced as a
+#: residency miss.  Never a client-visible submit op.
+MISS_OPS = ("posv_cached_miss",)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -74,9 +85,36 @@ def bucket_for(op: str, a_shape, b_shape, dtype: str, cfg) -> Bucket | None:
     diagonal blocks, A[1] the sub-diagonal blocks (A[1, 0] dead) — and
     B = (nblocks, b, nrhs), bucketing nblocks and b on their own ladders
     (cfg.nblocks_buckets / cfg.block_buckets); nrhs shares the dense
-    ladder."""
-    if op not in OPS:
+    ladder.
+
+    The factor-residency ops bucket on the ENGINE-COMPOSED operands, not
+    the wire payload: chol_update/chol_downdate as (resident R (n, n),
+    V (n, k)) with k on the nrhs ladder; posv_cached as (resident R,
+    RHS) with posv's exact geometry (posv_cached_miss: (A, RHS), same
+    shapes, different program); blocktri_extend as (appended chain
+    (2, nblocks, b, b), resident carry (b, b))."""
+    if op not in OPS and op not in MISS_OPS:
         raise ValueError(f"unknown serve op {op!r}; expected one of {OPS}")
+    if op in ("chol_update", "chol_downdate"):
+        nb = _pick(cfg.buckets, a_shape[0])
+        kb = _pick(cfg.nrhs_buckets, b_shape[1])
+        if nb is None or kb is None:
+            return None
+        return Bucket(op, dtype, (nb, nb), (nb, kb), cfg.max_batch)
+    if op in ("posv_cached", "posv_cached_miss"):
+        nb = _pick(cfg.buckets, a_shape[0])
+        kb = _pick(cfg.nrhs_buckets, b_shape[1])
+        if nb is None or kb is None:
+            return None
+        return Bucket(op, dtype, (nb, nb), (nb, kb), cfg.max_batch)
+    if op == "blocktri_extend":
+        _, nblocks, b, _ = a_shape
+        nbb = _pick(cfg.nblocks_buckets, nblocks)
+        bb = _pick(cfg.block_buckets, b)
+        if nbb is None or bb is None:
+            return None
+        return Bucket(op, dtype, (2, nbb, bb, bb), (bb, bb),
+                      cfg.max_batch)
     if op == "posv_blocktri":
         _, nblocks, b, _ = a_shape
         nbb = _pick(cfg.nblocks_buckets, nblocks)
@@ -116,6 +154,18 @@ def pad_operands(op: str, A, B, bucket: Bucket):
     with tracing.scope("serve::pad"):
         if op == "posv_blocktri":
             return _pad_blocktri(A, B, bucket)
+        if op == "blocktri_extend":
+            return _pad_blocktri_extend(A, B, bucket)
+        if op in ("chol_update", "chol_downdate"):
+            # diag(R, I) stays a valid upper factor (of diag(A, I)) and
+            # the zero-filled V rows/columns make every padded rotation a
+            # t = 0 no-op — the pad is a fixed point of the update, so
+            # cropping recovers the true R' exactly
+            pa = masking.embed_identity_tail(A, *bucket.a_shape)
+            n, k = B.shape
+            pb = jnp.pad(B, ((0, bucket.b_shape[0] - n),
+                             (0, bucket.b_shape[1] - k)))
+            return pa, pb
         pa = masking.embed_identity_tail(A, *bucket.a_shape)
         pb = None
         if bucket.b_shape is not None:
@@ -150,6 +200,26 @@ def _pad_blocktri(A, B, bucket: Bucket):
     return pa, pb
 
 
+def _pad_blocktri_extend(A, carry, bucket: Bucket):
+    """Structure-safe pad for the chain-extension operands: the appended
+    blocks pad exactly like `_pad_blocktri` (diag(D_i, I) embeds, zero
+    couplings, appended identity blocks), and the resident carry L_last
+    embeds as diag(L_last, I) — a valid lower factor of diag(S_last, I),
+    so the first appended block's coupling solve W₁ = C̃₁·L̃₀⁻ᵀ is exact
+    block-diagonal arithmetic (the zero-padded C rows never touch the
+    identity tail).  Bitwise-inert like every serve pad."""
+    _, nblocks, b, _ = A.shape
+    nbb, bb = bucket.a_shape[1], bucket.a_shape[2]
+    pa = jnp.pad(A, ((0, 0), (0, nbb - nblocks),
+                     (0, bb - b), (0, bb - b)))
+    eye = jnp.eye(bb, dtype=A.dtype)
+    tail = jnp.where(jnp.arange(bb) >= b, eye, jnp.zeros_like(eye))
+    blk = (jnp.arange(nbb) < nblocks)[:, None, None]
+    pa = pa.at[0].add(jnp.where(blk, tail, eye))
+    pcarry = masking.embed_identity_tail(carry, bb, bb)
+    return pa, pcarry
+
+
 def fill_problem(bucket: Bucket):
     """The benign problem that tops a short batch up to capacity: an
     identity operand (SPD for posv/inv, orthonormal columns for lstsq —
@@ -157,10 +227,14 @@ def fill_problem(bucket: Bucket):
     For posv_blocktri the fill is the identity CHAIN: identity diagonal
     blocks, zero couplings — every block factors to L = I exactly."""
     dt = jnp.dtype(bucket.dtype)
-    if bucket.op == "posv_blocktri":
+    if bucket.op in ("posv_blocktri", "blocktri_extend"):
         _, nbb, bb, _ = bucket.a_shape
         eyes = jnp.broadcast_to(jnp.eye(bb, dtype=dt), (nbb, bb, bb))
         fa = jnp.stack([eyes, jnp.zeros((nbb, bb, bb), dt)])
+        if bucket.op == "blocktri_extend":
+            # identity carry: extending the identity chain from L = I
+            # factors every fill block to L = I exactly
+            return fa, jnp.eye(bb, dtype=dt)
         return fa, jnp.zeros(bucket.b_shape, dtype=dt)
     fa = jnp.eye(*bucket.a_shape, dtype=dt)
     fb = None
@@ -190,10 +264,14 @@ def crop(op: str, X, a_shape, b_shape):
     """Slice one padded per-problem solution back to the request's true
     shape (the unpad half of the masking contract: the identity tail's
     rows of X are exact zeros and are dropped here)."""
-    if op == "posv":
+    if op in ("posv", "posv_cached", "posv_cached_miss"):
         return X[: a_shape[0], : b_shape[1]]
     if op == "lstsq":
         return X[: a_shape[1], : b_shape[1]]
     if op == "posv_blocktri":
         return X[: a_shape[1], : a_shape[2], : b_shape[2]]
+    if op == "blocktri_extend":
+        # stacked (2, nbb, bb, bb) [L; Wt] back to the appended blocks
+        return X[:, : a_shape[1], : a_shape[2], : a_shape[2]]
+    # inv / chol_update / chol_downdate: square (n, n) principal window
     return X[: a_shape[0], : a_shape[0]]

@@ -48,12 +48,13 @@ import jax
 import jax.numpy as jnp
 
 from capital_tpu.models import blocktri
-from capital_tpu.ops import batched_small, blocktri_small
+from capital_tpu.ops import batched_small, blocktri_small, lapack, update_small
 from capital_tpu.parallel.topology import Grid
 from capital_tpu.robust import faultinject
-from capital_tpu.robust.config import RobustConfig
+from capital_tpu.robust.config import RobustConfig, RobustInfo
 from capital_tpu.serve import api, batching, stats
 from capital_tpu.serve.cache import ExecutableCache
+from capital_tpu.serve.factorcache import FactorCache
 from capital_tpu.serve.executor import (  # noqa: F401  (re-exported API)
     Executor,
     Response,
@@ -117,6 +118,14 @@ class ServeConfig:
         (serve/cache.py); None keeps the cache in-memory only.  NOT in
         the config hash — the hash keys WHAT is compiled, the dir is
         WHERE it is remembered.
+    factor_cache_bytes: byte budget of the resident-factor pool
+        (serve/factorcache.py — the chol_update / chol_downdate /
+        posv_cached / blocktri_extend residency state).  NOT in the
+        config hash, deliberately: residency is host-side runtime policy
+        (which factors are remembered), the compiled bucket programs are
+        keyed by shape alone — two engines differing only here share
+        cache entries and a persistent dir on purpose, and a resizing
+        never recompiles anything.
     """
 
     buckets: tuple[int, ...] = (256, 512, 1024)
@@ -135,6 +144,7 @@ class ServeConfig:
     scheduler: str = "continuous"
     max_inflight: int = 2
     persist_dir: Optional[str] = None
+    factor_cache_bytes: int = 256 << 20
 
 
 class SolveEngine:
@@ -168,6 +178,9 @@ class SolveEngine:
         self.validate = validate
         self.stats = stats.Collector()
         self.cache = ExecutableCache(cfg.persist_dir)
+        # host-side resident-factor pool (serve/factorcache.py): never part
+        # of a traced program, so residency changes never recompile
+        self.factors = FactorCache(cfg.factor_cache_bytes)
         self.executor = Executor(cfg, self.grid, self.stats)
         self.scheduler = Scheduler(cfg, self.executor, self._resolve_bucket)
         self._next_id = 0
@@ -202,20 +215,36 @@ class SolveEngine:
             # forced pallas included: api._batched_pallas falls back to the
             # vmap program for f64, so the executable is NOT small-route
             return False
-        if bucket.op == "posv_blocktri":
+        if bucket.op in ("posv_blocktri", "blocktri_extend"):
             # the chain resolves through blocktri_small's own gate (per
             # scan step, not per bucket problem); impl mapping mirrors
             # api._batched_blocktri ('vmap'->xla handled above, forced
-            # pallas variants below)
+            # pallas variants below).  extend's scan step is the factor
+            # step at k = b (no RHS rides the chain).
             if impl in ("pallas", "pallas_split"):
                 return True
             _, nblocks, b, _ = bucket.a_shape
             seg = blocktri.resolve_seg(nblocks)
+            k = bucket.b_shape[2] if bucket.op == "posv_blocktri" else b
             return blocktri_small.default_impl(
-                b, bucket.b_shape[2], seg, bucket.dtype
+                b, k, seg, bucket.dtype
+            ) == "pallas"
+        if bucket.op in ("chol_update", "chol_downdate"):
+            if impl in ("pallas", "pallas_split"):
+                return True
+            return update_small.default_impl(
+                bucket.a_shape[0], bucket.b_shape[1], bucket.dtype
             ) == "pallas"
         if impl in ("pallas", "pallas_split"):
             return True
+        if bucket.op in ("posv_cached", "posv_cached_miss"):
+            # potrs / potrf+potrs against posv's exact geometry — posv's
+            # resolution is the right proxy (api's auto does the same)
+            a_shape = (bucket.capacity,) + bucket.a_shape
+            b_shape = (bucket.capacity,) + bucket.b_shape
+            return batched_small.default_impl(
+                "posv", a_shape, b_shape, bucket.dtype
+            ) == "pallas"
         a_shape = (bucket.capacity,) + bucket.a_shape
         if bucket.op == "inv":
             # inv rides the posv kernel with an identity RHS (api.batched):
@@ -311,12 +340,24 @@ class SolveEngine:
 
     # ---- request path ------------------------------------------------------
 
-    def submit(self, op: str, A, B=None) -> Ticket:
+    def submit(self, op: str, A, B=None, *,
+               factor_token: Optional[str] = None) -> Ticket:
         """Enqueue one solve request; returns a Ticket that resolves when
         its batch lands.  A capacity-full bucket DISPATCHES inside this
         call; under the continuous scheduler the dispatch is issued
         without waiting (the ticket is `done`, and `result()`/`pump()`/
-        `drain()` land it)."""
+        `drain()` land it).
+
+        `factor_token` names a resident factor for the factor-residency
+        ops (docs/SERVING.md "Factor residency"): chol_update /
+        chol_downdate submit only the rank-k panel A = V (n, k) against
+        the resident factor (loud failure when not resident — V alone
+        cannot determine the answer); posv_cached submits the full
+        (A, B) so a miss can seed the factor by refactoring; and
+        blocktri_extend submits the appended chain packing
+        A = (2, nblocks, b, b) — a never-seen token seeds a fresh chain
+        (C[:, 0] zeroed host-side), an EVICTED token fails loudly (a
+        silently re-seeded chain would be a wrong answer)."""
         t_enq = time.monotonic()
         tid = self._next_id
         self._next_id += 1
@@ -326,6 +367,19 @@ class SolveEngine:
         if op not in batching.OPS:
             raise ValueError(
                 f"unknown serve op {op!r}; expected one of {batching.OPS}"
+            )
+        if op in batching.FACTOR_OPS:
+            if factor_token is None:
+                raise ValueError(
+                    f"{op} requires factor_token= (docs/SERVING.md "
+                    "'Factor residency')"
+                )
+            return self._submit_factor(ticket, op, A, B,
+                                       str(factor_token), t_enq)
+        if factor_token is not None:
+            raise ValueError(
+                f"factor_token is only valid for {batching.FACTOR_OPS}, "
+                f"got op {op!r}"
             )
         if op == "posv_blocktri":
             if (A.ndim != 4 or A.shape[0] != 2
@@ -374,20 +428,8 @@ class SolveEngine:
                 self._run_single(ticket, op, A, B, t_enq)
             return ticket
         pa, pb = batching.pad_operands(op, A, B, bucket)
-        if self.cfg.scheduler == "continuous":
-            # async host->device staging AHEAD of dispatch: the transfer
-            # overlaps whatever batch is currently executing, so by flush
-            # time the operands are already device-resident (on-device
-            # no-op when eager padding placed them there)
-            with tracing.scope("SV::stage"):
-                pa = jax.device_put(pa, self._stage_device)
-                if pb is not None:
-                    pb = jax.device_put(pb, self._stage_device)
-        self.scheduler.admit(bucket, _Pending(
-            ticket, pa, pb, tuple(A.shape),
-            tuple(B.shape) if B is not None else None, t_enq,
-        ))
-        self.stats.note_queue_depth(self.queue_depth())
+        self._admit(ticket, bucket, pa, pb, tuple(A.shape),
+                    tuple(B.shape) if B is not None else None, t_enq)
         return ticket
 
     def pump(self, now: Optional[float] = None) -> int:
@@ -404,9 +446,10 @@ class SolveEngine:
         batches flushed."""
         return self.scheduler.drain()
 
-    def solve(self, op: str, A, B=None) -> Response:
+    def solve(self, op: str, A, B=None, *,
+              factor_token: Optional[str] = None) -> Response:
         """Convenience synchronous path: submit + drain + result."""
-        ticket = self.submit(op, A, B)
+        ticket = self.submit(op, A, B, factor_token=factor_token)
         if not ticket.done:
             self.drain()
         return ticket.result()
@@ -419,10 +462,363 @@ class SolveEngine:
         ledger record (appended to `path` when given)."""
         return self.stats.emit(
             path, grid=self.grid, config=self.cfg,
-            cache=self.cache_stats(), **extra,
+            cache=self.cache_stats(), factor_cache=self.factors.stats(),
+            **extra,
         )
 
+    # ---- factor residency (docs/SERVING.md "Factor residency") -------------
+
+    def install_factor(self, token: str, R) -> list[str]:
+        """Out-of-band seeding: install an upper-triangular R (A = RᵀR,
+        the lapack.potrf uplo='U' convention) as the resident dense
+        factor for `token`.  The serve-path seeding route is a
+        posv_cached miss; this exists for clients that factored locally
+        and want updates/solves without one priced miss.  Returns the
+        tokens the byte budget evicted to make room."""
+        R = jnp.asarray(R)
+        if R.ndim != 2 or R.shape[0] != R.shape[1]:
+            raise ValueError(
+                f"install_factor needs a square (n, n) factor, got {R.shape}"
+            )
+        return self.factors.put(
+            token, "dense", (R,),
+            {"n": int(R.shape[0]), "dtype": str(R.dtype)},
+        )
+
+    def release_factor(self, token: str) -> bool:
+        """Explicit client drop of a resident factor (clears any eviction
+        tombstone — the token is free for honest reuse).  Returns whether
+        an entry was resident."""
+        return self.factors.release(token)
+
+    def factor_stats(self) -> dict:
+        """The FactorCache counter block (hits/misses/evictions/installs/
+        released/downdate_degrades/bytes/hit_rate) — also emitted inside
+        every serve:request_stats record once factor traffic exists."""
+        return self.factors.stats()
+
     # ---- internals ---------------------------------------------------------
+
+    def _admit(self, ticket: Ticket, bucket: batching.Bucket, pa, pb,
+               a_shape, b_shape, t_enq: float, client_op=None,
+               sink=None) -> None:
+        """Stage + enqueue one padded request (the shared tail of submit
+        and _submit_factor)."""
+        if self.cfg.scheduler == "continuous":
+            # async host->device staging AHEAD of dispatch: the transfer
+            # overlaps whatever batch is currently executing, so by flush
+            # time the operands are already device-resident (on-device
+            # no-op when eager padding placed them there)
+            with tracing.scope("SV::stage"):
+                pa = jax.device_put(pa, self._stage_device)
+                if pb is not None:
+                    pb = jax.device_put(pb, self._stage_device)
+        self.scheduler.admit(bucket, _Pending(
+            ticket, pa, pb, a_shape, b_shape, t_enq,
+            client_op=client_op, sink=sink,
+        ))
+        self.stats.note_queue_depth(self.queue_depth())
+
+    def _submit_factor(self, ticket: Ticket, op: str, A, B, token: str,
+                       t_enq: float) -> Ticket:
+        """The factor-residency submit path.  Residency resolves HERE,
+        host-side, before padding or staging — the compiled bucket
+        programs never see tokens, so residency changes never recompile
+        anything.  Every not-servable case lands a LOUD failed Response,
+        never a silent wrong answer: update/downdate against a
+        non-resident token (V alone cannot determine the answer), any
+        kind/shape/dtype mismatch with the resident entry, an extend
+        against an EVICTED chain (a silently re-seeded identity chain
+        would be a wrong answer), and oversize shapes regardless of
+        cfg.oversize (the models/ paths have no residency to serve
+        against)."""
+        if op in ("chol_update", "chol_downdate"):
+            if A.ndim != 2 or B is not None:
+                raise ValueError(
+                    f"{op} needs A = V (n, k), no B — the resident factor "
+                    f"is the other operand; got A {A.shape}"
+                    + ("" if B is None else f", B {B.shape}")
+                )
+        elif op == "posv_cached":
+            if A.ndim != 2 or A.shape[0] != A.shape[1]:
+                raise ValueError(
+                    f"posv_cached needs a square SPD operand, got {A.shape}"
+                )
+            if B is None or B.ndim != 2 or B.shape[0] != A.shape[0]:
+                raise ValueError(
+                    f"posv_cached needs a 2D RHS with {A.shape[0]} rows, "
+                    f"got {None if B is None else B.shape}"
+                )
+        else:  # blocktri_extend
+            if A.ndim != 4 or A.shape[0] != 2 or A.shape[2] != A.shape[3]:
+                raise ValueError(
+                    f"blocktri_extend needs A = (2, nblocks, b, b) appended "
+                    f"[diagonal, sub-diagonal] blocks, got {A.shape}"
+                )
+            if B is not None:
+                raise ValueError(
+                    f"blocktri_extend takes no B (the resident carry is "
+                    f"the second operand), got B {B.shape}"
+                )
+        try:
+            # same host-side per-request tap as submit(): a planted fault
+            # corrupts exactly one request's operand and never bakes into
+            # a cached executable OR a resident factor (sinks refuse to
+            # install flagged results)
+            A = faultinject.tap(A, point="serve::ingest")
+        except faultinject.FaultInjected as e:
+            self.executor.fail(ticket, op, str(e), t_enq)
+            return ticket
+        dt = str(A.dtype)
+        ent = self.factors.lookup(token)
+
+        def lose(msg: str) -> Ticket:
+            self.executor.fail(
+                ticket, op,
+                msg + " (docs/SERVING.md 'Factor residency')", t_enq,
+            )
+            return ticket
+
+        if op in ("chol_update", "chol_downdate"):
+            if ent is None:
+                why = ("evicted" if self.factors.evicted(token)
+                       else "never seeded")
+                return lose(
+                    f"factor_token {token!r} not resident ({why}): {op} "
+                    "ships only the rank-k panel V, so there is nothing to "
+                    "update — seed with posv_cached or install_factor()"
+                )
+            if ent.kind != "dense":
+                return lose(
+                    f"factor_token {token!r} holds a {ent.kind} factor; "
+                    f"{op} needs a dense one"
+                )
+            R = ent.arrays[0]
+            n = int(R.shape[0])
+            if A.shape[0] != n or str(R.dtype) != dt:
+                return lose(
+                    f"V {A.shape}/{dt} does not ride the resident factor "
+                    f"({n}, {n})/{R.dtype} under token {token!r}"
+                )
+            bucket = batching.bucket_for(op, (n, n), tuple(A.shape), dt,
+                                         self.cfg)
+            if bucket is None:
+                return lose(
+                    f"no bucket for {op} n={n} k={A.shape[1]}: factor ops "
+                    "have no oversize route"
+                )
+            pa, pb = batching.pad_operands(op, R, A, bucket)
+            self._admit(
+                ticket, bucket, pa, pb, (n, n), tuple(A.shape), t_enq,
+                client_op=op, sink=self._update_sink(op, token, n, A),
+            )
+            return ticket
+
+        if op == "posv_cached":
+            n = int(A.shape[0])
+            if ent is not None:
+                if ent.kind != "dense":
+                    return lose(
+                        f"factor_token {token!r} holds a {ent.kind} "
+                        "factor; posv_cached needs a dense one"
+                    )
+                R = ent.arrays[0]
+                if int(R.shape[0]) != n or str(R.dtype) != dt:
+                    return lose(
+                        f"operand {A.shape}/{dt} does not match the "
+                        f"resident factor {tuple(R.shape)}/{R.dtype} "
+                        f"under token {token!r}"
+                    )
+                bucket = batching.bucket_for(
+                    "posv_cached", (n, n), tuple(B.shape), dt, self.cfg)
+                if bucket is None:
+                    return lose(
+                        f"no bucket for posv_cached n={n} "
+                        f"nrhs={B.shape[1]}: factor ops have no oversize "
+                        "route"
+                    )
+                pa, pb = batching.pad_operands("posv_cached", R, B, bucket)
+                self._admit(ticket, bucket, pa, pb, (n, n),
+                            tuple(B.shape), t_enq, client_op="posv_cached")
+                return ticket
+            # miss: seed by refactoring through the 3-output miss program
+            # (X, R, info) — the full operand is on the wire, so re-seeding
+            # is safe even for an evicted token (unlike extend, no hidden
+            # state is lost); priced as a residency miss
+            bucket = batching.bucket_for(
+                "posv_cached_miss", tuple(A.shape), tuple(B.shape), dt,
+                self.cfg)
+            if bucket is None:
+                return lose(
+                    f"no bucket for posv_cached n={n} nrhs={B.shape[1]}: "
+                    "factor ops have no oversize route"
+                )
+            pa, pb = batching.pad_operands("posv_cached_miss", A, B, bucket)
+            self._admit(
+                ticket, bucket, pa, pb, tuple(A.shape), tuple(B.shape),
+                t_enq, client_op="posv_cached",
+                sink=self._seed_sink(token, n),
+            )
+            return ticket
+
+        # blocktri_extend
+        nblocks, b = int(A.shape[1]), int(A.shape[2])
+        if ent is not None:
+            if ent.kind != "blocktri":
+                return lose(
+                    f"factor_token {token!r} holds a {ent.kind} factor; "
+                    "blocktri_extend needs a blocktri chain"
+                )
+            if int(ent.meta["b"]) != b or ent.meta["dtype"] != dt:
+                return lose(
+                    f"appended blocks {A.shape}/{dt} do not ride the "
+                    f"resident chain b={ent.meta['b']}/"
+                    f"{ent.meta['dtype']} under token {token!r}"
+                )
+            carry = ent.arrays[2]
+            prior = int(ent.meta["nblocks"])
+        else:
+            if self.factors.evicted(token):
+                return lose(
+                    f"factor_token {token!r} was EVICTED: extending a "
+                    "silently re-seeded identity chain would be a wrong "
+                    "answer — resubmit the full chain under a fresh token"
+                )
+            # fresh chain: identity carry + zeroed first coupling run the
+            # SAME compiled program as a continuation (zero-recompile —
+            # seed/continue is data, not a shape)
+            carry = jnp.eye(b, dtype=A.dtype)
+            A = A.at[1, 0].set(jnp.zeros((b, b), A.dtype))
+            prior = 0
+        bucket = batching.bucket_for(
+            "blocktri_extend", tuple(A.shape), (b, b), dt, self.cfg)
+        if bucket is None:
+            return lose(
+                f"no bucket for blocktri_extend nblocks={nblocks} b={b}: "
+                "factor ops have no oversize route"
+            )
+        pa, pb = batching.pad_operands("blocktri_extend", A, carry, bucket)
+        self._admit(
+            ticket, bucket, pa, pb, tuple(A.shape), (b, b), t_enq,
+            client_op="blocktri_extend",
+            sink=self._extend_sink(token, b, prior),
+        )
+        return ticket
+
+    def _update_sink(self, op: str, token: str, n: int, V):
+        """Landing hook for chol_update / chol_downdate: install R' on a
+        clean info, refuse to install on breakdown.  A flagged DOWNDATE
+        degrades to a fresh refactor S = RᵀR − VVᵀ from the still-resident
+        OLD factor (put() only runs on success, so it was never
+        overwritten) — the docs/ROBUSTNESS.md 'downdate failure'
+        contract: degrade, and only if THAT also fails, fail loudly."""
+
+        def sink(x, extras, raw_info):
+            i = int(raw_info)
+            if i == 0:
+                self.factors.put(token, "dense", (x,),
+                                 {"n": n, "dtype": str(x.dtype)})
+                return x, raw_info, None
+            if op == "chol_update":
+                # a rank-k UPDATE of an SPD matrix cannot break down in
+                # exact arithmetic — a flag here means a poisoned operand
+                # (NaN/Inf V, e.g. an injected ingest fault).  No degrade
+                # identity exists; refuse the result loudly and leave the
+                # resident factor at its pre-update state.
+                return x, raw_info, (
+                    f"chol_update flagged breakdown (info={i}) — operand "
+                    f"is not finite-SPD-consistent; resident factor "
+                    f"{token!r} left unchanged"
+                )
+            ent = self.factors.peek(token)
+            if ent is None:
+                return x, raw_info, (
+                    f"chol_downdate breakdown (info={i}) and token "
+                    f"{token!r} was released/evicted mid-flight: no "
+                    "resident state to degrade from"
+                )
+            self.factors.note_downdate_degrade()
+            fn = self._get_degrade(n, int(V.shape[1]), str(V.dtype))
+            R2, info2 = jax.block_until_ready(fn(ent.arrays[0], V))
+            if int(info2) == 0:
+                self.factors.put(token, "dense", (R2,),
+                                 {"n": n, "dtype": str(R2.dtype)})
+                return R2, RobustInfo(info=0, breakdown=1, shifted=0,
+                                      sigma=0.0, escalated=1,
+                                      ortho=-1.0), None
+            return x, raw_info, (
+                f"chol_downdate breakdown (info={i}) and the degrade "
+                f"refactor ALSO failed (potrf info={int(info2)}): "
+                "A − VVᵀ is not positive definite — resident factor "
+                f"{token!r} left at its pre-downdate state"
+            )
+
+        return sink
+
+    def _seed_sink(self, token: str, n: int):
+        """Landing hook for the posv_cached miss program: install the
+        freshly-refactored R (cropped from its padded batch slot) — but
+        only on a clean info; a flagged refactor (operand not SPD) must
+        never become resident truth."""
+
+        def sink(x, extras, raw_info):
+            if int(raw_info) == 0:
+                R = extras[0][:n, :n]
+                self.factors.put(token, "dense", (R,),
+                                 {"n": n, "dtype": str(R.dtype)})
+            return x, raw_info, None
+
+        return sink
+
+    def _extend_sink(self, token: str, b: int, prior: int):
+        """Landing hook for blocktri_extend: append the new (L, Wt)
+        blocks to the resident chain and roll the carry to the new last
+        diagonal factor block.  A flagged extend installs nothing — the
+        resident prefix stays valid (the chain is sequential; a failed
+        suffix never corrupts it).  The landed info is SEGMENT-relative
+        (offset 0) by design: offsetting inside the program would key a
+        recompile per prefix length."""
+
+        def sink(x, extras, raw_info):
+            if int(raw_info) != 0:
+                return x, raw_info, None
+            L, Wt = x[0], x[1]
+            ent = self.factors.peek(token)
+            if ent is not None and ent.kind == "blocktri":
+                L = jnp.concatenate([ent.arrays[0], L], axis=0)
+                Wt = jnp.concatenate([ent.arrays[1], Wt], axis=0)
+            self.factors.put(
+                token, "blocktri", (L, Wt, L[-1]),
+                {"b": b, "nblocks": int(L.shape[0]),
+                 "dtype": str(L.dtype)},
+            )
+            return x, raw_info, None
+
+        return sink
+
+    def _get_degrade(self, n: int, k: int, dtype: str):
+        """The downdate-degrade program: refactor S = RᵀR − VVᵀ from
+        scratch (lapack.potrf upper, with info).  Cached under the warmup
+        counters on purpose — an exceptional-path compile must not read
+        as a steady-state recompile in the zero-recompile gates."""
+        key = ("degrade", n, k, dtype, self._grid_key, self._cfg_hash)
+
+        def build():
+            prec = self.cfg.precision
+
+            def fn(R, V):
+                with tracing.scope("UP::downdate"):
+                    S = (jnp.einsum("ji,jk->ik", R, R, precision=prec)
+                         - jnp.einsum("ik,jk->ij", V, V, precision=prec))
+                    return lapack.potrf(S, uplo="U", with_info=True)
+
+            dt = jnp.dtype(dtype)
+            return jax.jit(fn).lower(
+                jax.ShapeDtypeStruct((n, n), dt),
+                jax.ShapeDtypeStruct((n, k), dt),
+            ).compile()
+
+        return self.cache.get(key, build, warmup=True)
 
     def _run_single(self, ticket: Ticket, op: str, A, B,
                     t_enq: float) -> None:

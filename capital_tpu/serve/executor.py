@@ -105,7 +105,15 @@ class Ticket:
 
 @dataclasses.dataclass
 class _Pending:
-    """One queued request: its ticket plus the padded, staged operands."""
+    """One queued request: its ticket plus the padded, staged operands.
+
+    The factor-residency fields ride along host-side (serve/factorcache):
+    `client_op` is the op the CLIENT submitted when the bucket runs an
+    internal program on its behalf (posv_cached_miss buckets land as
+    posv_cached responses/stats); `sink` is the engine's landing hook —
+    called with (cropped_x, extra_outputs, raw_info), it installs/updates
+    the resident factor and may REWRITE the landed result (the downdate
+    degrade path) or fail it loudly; returns (x, info, error)."""
 
     ticket: Ticket
     pa: jnp.ndarray
@@ -113,6 +121,8 @@ class _Pending:
     a_shape: tuple[int, ...]
     b_shape: Optional[tuple[int, ...]]
     t_enq: float
+    client_op: Optional[str] = None
+    sink: Optional[object] = None
 
 
 @dataclasses.dataclass
@@ -147,8 +157,22 @@ class Executor:
         """The jit donation declaration for one bucket program: posv's RHS
         batch, inv's operand batch, nothing for lstsq (its (m, nrhs) RHS
         cannot alias the (n, nrhs) solution — XLA would silently drop the
-        declaration; the lint donation-honored rule's point)."""
+        declaration; the lint donation-honored rule's point).
+
+        Factor-residency buckets: chol_update/chol_downdate donate the
+        assembled FACTOR batch (argnum 0 — shaped exactly like the R'
+        output, and an engine-built stack of padded copies, so the
+        resident originals in the FactorCache stay intact); posv_cached
+        donates its RHS like posv.  The miss and extend programs donate
+        nothing (3-output / carry-shaped operands XLA would drop the
+        declaration for)."""
         if not self.donate():
+            return ()
+        if bucket.op in ("chol_update", "chol_downdate"):
+            return (0,)
+        if bucket.op == "posv_cached":
+            return (1,)
+        if bucket.op in ("posv_cached_miss", "blocktri_extend"):
             return ()
         if bucket.b_shape is not None:
             return (1,) if bucket.op == "posv" else ()
@@ -194,12 +218,34 @@ class Executor:
         if fl.landed:
             return
         fl.landed = True
-        X, info = jax.block_until_ready(fl.outputs)
+        # programs return (X, info) — the factor-residency miss program
+        # returns (X, R, info); everything between the primary output and
+        # the trailing info batch is an extra the landing sink consumes
+        *xs, info = jax.block_until_ready(fl.outputs)
         t_land = time.monotonic()
         for i, p in enumerate(fl.pending):
-            xi = batching.crop(fl.bucket.op, X[i], p.a_shape, p.b_shape)
+            xi = batching.crop(fl.bucket.op, xs[0][i], p.a_shape, p.b_shape)
+            ri = info[i]
+            err = None
+            if p.sink is not None:
+                xi, ri, err = p.sink(xi, tuple(x[i] for x in xs[1:]), ri)
+            op = p.client_op or fl.bucket.op
+            if err is not None:
+                # the sink refused the result (double-failed downdate
+                # degrade): land it as a LOUD failure, never a silent
+                # wrong answer (docs/ROBUSTNESS.md)
+                lat = t_land - p.t_enq
+                p.ticket.response = Response(
+                    request_id=p.ticket.request_id, op=op, ok=False,
+                    x=None, info=self._norm_info(ri), error=err,
+                    bucket=fl.bucket.key, batched=True, latency_s=lat,
+                    queue_wait_s=max(0.0, fl.t0 - p.t_enq),
+                    device_s=max(0.0, t_land - fl.t0),
+                )
+                self.stats.record_request(op, lat, ok=False, failed=True)
+                continue
             self._finish(
-                p.ticket, fl.bucket.op, xi, info[i], fl.bucket.key,
+                p.ticket, op, xi, ri, fl.bucket.key,
                 batched=True, t_enq=p.t_enq, t0=fl.t0, t_land=t_land,
                 small=fl.small,
             )

@@ -1,0 +1,170 @@
+"""FactorCache: a bounded, byte-budgeted pool of resident factors.
+
+The serve-side half of online factor maintenance (docs/SERVING.md "Factor
+residency"): clients name a factor with a token of their choosing, seed it
+once (`posv_cached` on a miss refactors and installs; `blocktri_extend` on
+a fresh token seeds an identity-carry chain), then mutate it in O(kn²)
+(`chol_update` / `chol_downdate`) or O(nblocks·b³) (`blocktri_extend`) and
+solve against it (`posv_cached`) without ever re-shipping the matrix — the
+wire protocol for the update ops carries only the rank-k panel V.
+
+Policy, deliberately boring:
+
+* **LRU over a byte budget** — `put` evicts least-recently-used entries
+  until the pool fits `budget_bytes`; the newest entry is kept even when
+  it alone exceeds the budget (a pool that rejects every factor larger
+  than the budget would turn every update into a loud miss with no way
+  out).  `lookup` refreshes recency.
+* **Tombstones** — an evicted token is remembered.  The engine uses the
+  distinction to fail evicted-token traffic LOUDLY (an update against a
+  silently re-seeded identity factor would be a wrong answer) while
+  letting never-seen `blocktri_extend` tokens seed fresh chains.
+  `release` (the client's explicit drop) clears the tombstone too: a
+  released token is free for honest reuse.
+* **Counters, not policy** — hits / misses / evictions / installs /
+  released / downdate_degrades accumulate here and surface through
+  `stats.Collector.snapshot(factor_cache=...)` into the
+  `serve:request_stats` ledger record, where `obs serve-report
+  --min-residency-hit-rate` gates them (the residency hit-rate is the
+  cost model's whole justification: a miss is priced as a full refactor).
+
+The cache is host-side state keyed by client tokens: it never enters a
+traced program, so residency changes NEVER recompile anything — the
+bucket executables are keyed by shape alone, and the engine's config hash
+deliberately excludes the byte budget (ServeConfig.factor_cache_bytes is
+runtime policy: WHERE factors live, not WHAT was compiled).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import OrderedDict
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _nbytes(arrays) -> int:
+    return int(sum(a.size * jnp.dtype(a.dtype).itemsize for a in arrays))
+
+
+@dataclasses.dataclass
+class FactorEntry:
+    """One resident factor.  `kind` is 'dense' (arrays = (R,), upper
+    A = RᵀR) or 'blocktri' (arrays = (L, Wt, carry): the appended-so-far
+    chain factor in the models/blocktri representation plus the running
+    (b, b) diagonal carry the next extend continues from).  `meta` is
+    engine bookkeeping (shapes/dtype used for request validation)."""
+
+    kind: str
+    arrays: tuple
+    nbytes: int
+    meta: dict
+
+
+class FactorCache:
+    """See module docstring.  Not thread-safe, like the engine that owns
+    one (a single dispatch loop)."""
+
+    def __init__(self, budget_bytes: int = 256 << 20):
+        if budget_bytes <= 0:
+            raise ValueError(
+                f"factor cache budget must be positive, got {budget_bytes}")
+        self.budget_bytes = int(budget_bytes)
+        self._entries: "OrderedDict[str, FactorEntry]" = OrderedDict()
+        self._tombstones: set[str] = set()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.installs = 0
+        self.released = 0
+        self.downdate_degrades = 0
+
+    # ---- residency ---------------------------------------------------------
+
+    def lookup(self, token: str) -> Optional[FactorEntry]:
+        """Resident entry for `token` (refreshes LRU recency) or None.
+        Counts a hit or a miss — call exactly once per request."""
+        e = self._entries.get(token)
+        if e is None:
+            self.misses += 1
+            return None
+        self.hits += 1
+        self._entries.move_to_end(token)
+        return e
+
+    def peek(self, token: str) -> Optional[FactorEntry]:
+        """lookup without counters or recency (engine internals/tests)."""
+        return self._entries.get(token)
+
+    def evicted(self, token: str) -> bool:
+        """Whether `token` WAS resident and got evicted (tombstoned) —
+        the loud-failure predicate for stateful ops whose fresh-token
+        path would otherwise silently restart from the wrong state."""
+        return token in self._tombstones
+
+    def put(self, token: str, kind: str, arrays, meta: dict) -> list[str]:
+        """Install (or overwrite) a resident factor; evicts LRU entries
+        until the pool fits the byte budget (never the entry just
+        installed).  Returns the evicted tokens."""
+        arrays = tuple(jax.device_put(a) for a in arrays)
+        e = FactorEntry(kind=kind, arrays=arrays, nbytes=_nbytes(arrays),
+                        meta=dict(meta))
+        self._entries[token] = e
+        self._entries.move_to_end(token)
+        self._tombstones.discard(token)
+        self.installs += 1
+        evicted = []
+        while (self.resident_bytes() > self.budget_bytes
+               and len(self._entries) > 1):
+            victim, _ = self._entries.popitem(last=False)
+            self._tombstones.add(victim)
+            self.evictions += 1
+            evicted.append(victim)
+        return evicted
+
+    def release(self, token: str) -> bool:
+        """Explicit client drop.  Clears any tombstone — a released token
+        is free for honest reuse.  Returns whether an entry was resident."""
+        self._tombstones.discard(token)
+        if token in self._entries:
+            del self._entries[token]
+            self.released += 1
+            return True
+        return False
+
+    # ---- accounting --------------------------------------------------------
+
+    def note_downdate_degrade(self) -> None:
+        """A flagged downdate was degraded to a fresh refactor at landing
+        (docs/ROBUSTNESS.md) — counted here so the residency stats block
+        carries it even when no RobustConfig is attached."""
+        self.downdate_degrades += 1
+
+    def resident_bytes(self) -> int:
+        return sum(e.nbytes for e in self._entries.values())
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, token: str) -> bool:
+        return token in self._entries
+
+    def stats(self) -> dict:
+        """The factor_cache counter block of `serve:request_stats`
+        (obs.ledger.validate_request_stats validates it; `obs
+        serve-report --min-residency-hit-rate` gates hit_rate)."""
+        lookups = self.hits + self.misses
+        return {
+            "hits": self.hits,
+            "misses": self.misses,
+            "evictions": self.evictions,
+            "installs": self.installs,
+            "released": self.released,
+            "downdate_degrades": self.downdate_degrades,
+            "entries": len(self._entries),
+            "bytes": self.resident_bytes(),
+            "budget_bytes": self.budget_bytes,
+            "hit_rate": (self.hits / lookups) if lookups else 1.0,
+        }

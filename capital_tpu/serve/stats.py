@@ -87,13 +87,21 @@ class Collector:
     # ---- reporting ---------------------------------------------------------
 
     def snapshot(self, cache: dict | None = None, *,
+                 factor_cache: dict | None = None,
                  samples: bool = False) -> dict:
         """The request_stats block.  `cache` is the engine's cache_stats()
         (hits/misses/hit_rate/warmup_compiles); zeros when absent so the
-        schema stays total.  `samples=True` attaches the raw latency
-        populations (seconds) so merge_snapshots can pool percentiles
-        exactly instead of max-of-p99 — meant for router-internal
-        aggregation, not for ledger records (strip it before append)."""
+        schema stays total.  `factor_cache` is the FactorCache counter
+        block (serve/factorcache.py stats()) — attached ONLY when factor
+        traffic happened (lookups or installs), the same optional-block
+        discipline as latency_ms_small, so pre-PR-12 records and engines
+        that never serve factor ops keep their exact schema and `obs
+        serve-report --min-residency-hit-rate` can fail loudly when the
+        block is absent rather than passing on a vacuous 1.0.
+        `samples=True` attaches the raw latency populations (seconds) so
+        merge_snapshots can pool percentiles exactly instead of
+        max-of-p99 — meant for router-internal aggregation, not for
+        ledger records (strip it before append)."""
         from capital_tpu.obs.ledger import SCHEMA_VERSION
 
         lat = (
@@ -145,6 +153,10 @@ class Collector:
                 k: round(v * 1e3, 4)
                 for k, v in percentiles(self.devices_s).items()
             }
+        if factor_cache and (factor_cache.get("hits", 0)
+                             + factor_cache.get("misses", 0)
+                             + factor_cache.get("installs", 0)) > 0:
+            snap["factor_cache"] = dict(factor_cache)
         if self.replica_id is not None:
             snap["replica_id"] = str(self.replica_id)
         if samples:
@@ -157,7 +169,8 @@ class Collector:
         return snap
 
     def emit(self, path: str | None, *, grid=None, config=None,
-             cache: dict | None = None, **extra) -> dict:
+             cache: dict | None = None, factor_cache: dict | None = None,
+             **extra) -> dict:
         """Assemble (and append, when `path` is given) ONE ledger record
         carrying the snapshot — kind 'serve:request_stats', same manifest
         discipline as every other ledger row."""
@@ -166,7 +179,7 @@ class Collector:
         rec = ledger.record(
             "serve:request_stats",
             ledger.manifest(grid=grid, config=config),
-            request_stats=self.snapshot(cache),
+            request_stats=self.snapshot(cache, factor_cache=factor_cache),
             **extra,
         )
         if path:
@@ -272,6 +285,21 @@ def merge_snapshots(snaps: list[dict]) -> dict:
     if disk is not None:
         cache["disk"] = disk
     merged["cache"] = cache
+    # factor-residency counters sum like the cache block (hit_rate
+    # recomputed from summed lookups, never averaged); present only when
+    # some replica saw factor traffic — same optional-block discipline
+    # the snapshot itself follows.
+    fsnaps = [s["factor_cache"] for s in snaps if s.get("factor_cache")]
+    if fsnaps:
+        fc = {k: 0 for k in ("hits", "misses", "evictions", "installs",
+                             "released", "downdate_degrades", "entries",
+                             "bytes", "budget_bytes")}
+        for f in fsnaps:
+            for k in fc:
+                fc[k] += int(f.get(k, 0))
+        flook = fc["hits"] + fc["misses"]
+        fc["hit_rate"] = (fc["hits"] / flook) if flook else 1.0
+        merged["factor_cache"] = fc
     for name in ("latency_ms_small", "queue_wait_ms", "device_ms"):
         blk = _merge_pcts(snaps, name)
         if blk is not None:

@@ -162,6 +162,15 @@ PHASE_REGISTRY: tuple[str, ...] = (
     # posv_blocktri — one phase, one price, the SV::fused_posv rationale);
     # BT::solve covers the block-bidiagonal substitution sweeps.
     "BT::factor", "BT::solve",
+    # online factor maintenance (ops/update_small.py, models/blocktri.py
+    # extend, docs/SERVING.md "Factor residency").  UP::update /
+    # UP::downdate wrap the rank-k hyperbolic-rotation Cholesky
+    # update/downdate kernels (one scope per public call, priced whole —
+    # chol_update_flops); UP::extend wraps the blocktri chain-extension
+    # scan at the models layer (same outside-the-scan emit rationale as
+    # BT::factor: the scan body executes nsteps times, the price fires
+    # once).
+    "UP::update", "UP::downdate", "UP::extend",
 )
 _PHASE_SET: set[str] = set(PHASE_REGISTRY)
 
@@ -522,6 +531,18 @@ def blocktri_solve_flops(nblocks: int, b: int, k: int) -> float:
     width k plus the 2b²k off-diagonal coupling product.  A full potrs
     analog is two of these."""
     return nblocks * (batched_trsm_flops(b, k) + 2.0 * b**2 * k)
+
+
+def chol_update_flops(n: int, k: int) -> float:
+    """Rank-k Cholesky update/downdate sweep, per problem (UP::update /
+    UP::downdate): k rank passes x n hyperbolic rotations, each a one-hot
+    row extract (2n²) plus the full-width row write-back outer product
+    (2n²) plus the two width-n axpys — ≈ 4kn³ EXECUTED on the masked
+    pallas sweep, same executed-flop convention as batched_chol_flops.
+    The textbook useful count is ~2kn² (what the bench driver's speedup
+    numerator uses); the blocked J-orthogonal XLA path executes
+    ~(4p + 4k + 2k²/p)·n² at panel width p."""
+    return 4.0 * k * n**3
 
 
 def fused_lstsq_flops(m: int, n: int, k: int) -> float:

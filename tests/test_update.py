@@ -1,0 +1,634 @@
+"""Online factor maintenance (PR 12): ops/update_small rank-k Cholesky
+update/downdate, models/blocktri.extend, the serve FactorCache, and the
+factor-residency wire protocol through a real SolveEngine.
+
+The acceptance properties of ISSUE 12 / docs/SERVING.md "Factor residency"
+are asserted directly:
+
+* update/downdate match f64 NumPy refactor references across an (n, k)
+  ladder on both impls (TestUpdateParity);
+* breakdown surfaces as a nonzero info, never a silent wrong answer, and a
+  flagged downdate degrades to a fresh refactor from the still-resident
+  factor (TestBreakdown, TestDowndateDegrade);
+* extending a factored chain equals refactoring the whole chain
+  (TestExtendParity);
+* the FactorCache enforces its byte budget by LRU eviction with tombstones
+  (TestFactorCache);
+* factor traffic causes ZERO steady-state executable compiles, and
+  ServeConfig.factor_cache_bytes stays out of the executable identity
+  (TestServeResidency, TestCfgHashSeparation);
+* an injected ingest fault corrupts exactly one request — neighbor tokens'
+  resident factors stay bitwise intact (TestFaultContainment).
+
+Everything runs on the conftest CPU rig (x64 on, f32 arrays kept f32
+explicitly); engines use tiny bucket ladders so every executable compiles
+fast.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from capital_tpu.models import blocktri
+from capital_tpu.obs import __main__ as obs_main
+from capital_tpu.obs import ledger
+from capital_tpu.ops import update_small
+from capital_tpu.robust import faultinject
+from capital_tpu.serve import ServeConfig, SolveEngine, stats
+from capital_tpu.serve.factorcache import FactorCache
+
+
+def _spd(rng, n, dtype=np.float32):
+    M = rng.standard_normal((n, n))
+    return (M @ M.T / n + 3.0 * np.eye(n)).astype(dtype)
+
+
+def _chol_upper(A):
+    return np.linalg.cholesky(np.asarray(A, np.float64)).T
+
+
+def _rel_err(R, A):
+    """‖RᵀR − A‖_F / ‖A‖_F in f64 — the bench-update residual gate."""
+    R = np.asarray(R, np.float64)
+    A = np.asarray(A, np.float64)
+    return float(np.linalg.norm(R.T @ R - A) / np.linalg.norm(A))
+
+
+def _tol(dtype):
+    return 5e-5 if np.dtype(dtype) == np.float32 else 1e-12
+
+
+# ---------------------------------------------------------------------------
+# ops/update_small parity ladders
+# ---------------------------------------------------------------------------
+
+
+class TestUpdateParity:
+    @pytest.mark.parametrize("n,k", [(8, 1), (16, 4), (48, 8)])
+    @pytest.mark.parametrize("impl", ["pallas", "xla"])
+    def test_update_downdate_roundtrip_f32(self, n, k, impl):
+        rng = np.random.default_rng(n * 31 + k)
+        batch = 2
+        A = np.stack([_spd(rng, n, np.float64) for _ in range(batch)])
+        R = np.stack([_chol_upper(a) for a in A]).astype(np.float32)
+        V = ((0.1 / np.sqrt(n))
+             * rng.standard_normal((batch, n, k))).astype(np.float32)
+        R1, i1 = update_small.chol_update(
+            jnp.asarray(R), jnp.asarray(V), impl=impl)
+        assert i1.dtype == jnp.int32 and i1.shape == (batch,)
+        assert not np.any(np.asarray(i1))
+        Ap = A + np.asarray(V, np.float64) @ np.asarray(
+            V, np.float64).transpose(0, 2, 1)
+        for b in range(batch):
+            assert _rel_err(R1[b], Ap[b]) < _tol(np.float32)
+            # the factor stays upper triangular
+            assert not np.any(np.tril(np.asarray(R1[b]), -1))
+        R2, i2 = update_small.chol_downdate(R1, jnp.asarray(V), impl=impl)
+        assert not np.any(np.asarray(i2))
+        for b in range(batch):
+            assert _rel_err(R2[b], A[b]) < _tol(np.float32)
+
+    @pytest.mark.parametrize("n,k", [(16, 2), (64, 8)])
+    def test_update_downdate_roundtrip_f64(self, n, k):
+        # f64 always routes to the XLA panel scan (_resolve_impl)
+        rng = np.random.default_rng(n + k)
+        A = _spd(rng, n, np.float64)[None]
+        R = _chol_upper(A[0])[None]
+        V = ((0.1 / np.sqrt(n)) * rng.standard_normal((1, n, k)))
+        R1, i1 = update_small.chol_update(
+            jnp.asarray(R), jnp.asarray(V), impl="auto")
+        assert not np.any(np.asarray(i1))
+        Ap = A + V @ V.transpose(0, 2, 1)
+        assert _rel_err(R1[0], Ap[0]) < _tol(np.float64)
+        R2, i2 = update_small.chol_downdate(R1, jnp.asarray(V))
+        assert not np.any(np.asarray(i2))
+        assert _rel_err(R2[0], A[0]) < _tol(np.float64)
+
+    def test_impls_agree(self):
+        n, k = 32, 4
+        rng = np.random.default_rng(7)
+        R = _chol_upper(_spd(rng, n, np.float64))[None].astype(np.float32)
+        V = ((0.1 / np.sqrt(n))
+             * rng.standard_normal((1, n, k))).astype(np.float32)
+        Rp, _ = update_small.chol_update(
+            jnp.asarray(R), jnp.asarray(V), impl="pallas")
+        Rx, _ = update_small.chol_update(
+            jnp.asarray(R), jnp.asarray(V), impl="xla")
+        # different rotation orders — agreement to f32 sweep tolerance,
+        # checked through the reconstruction both must reproduce
+        Ap = (np.asarray(R[0], np.float64).T @ np.asarray(R[0], np.float64)
+              + np.asarray(V[0], np.float64) @ np.asarray(V[0], np.float64).T)
+        assert _rel_err(Rp[0], Ap) < 5e-5
+        assert _rel_err(Rx[0], Ap) < 5e-5
+
+    def test_shape_validation(self):
+        R = jnp.eye(8)[None]
+        with pytest.raises(ValueError, match="rank-k batch"):
+            update_small.chol_update(R, jnp.zeros((1, 4, 2)))
+        with pytest.raises(ValueError, match="factor batch"):
+            update_small.chol_update(jnp.zeros((8, 8)), jnp.zeros((8, 2)))
+
+
+class TestBreakdown:
+    @pytest.mark.parametrize("impl", ["pallas", "xla"])
+    def test_infeasible_downdate_flags(self, impl):
+        n, k = 16, 2
+        rng = np.random.default_rng(3)
+        A = _spd(rng, n, np.float64)
+        R = _chol_upper(A)[None].astype(np.float32)
+        # removing 100·(first columns of Rᵀ) is far outside A: indefinite
+        W = (10.0 * _chol_upper(A).T[:, :k])[None].astype(np.float32)
+        _, info = update_small.chol_downdate(
+            jnp.asarray(R), jnp.asarray(W), impl=impl)
+        assert int(np.asarray(info)[0]) != 0
+
+    @pytest.mark.parametrize("impl", ["pallas", "xla"])
+    def test_nonfinite_operand_flags_update(self, impl):
+        n, k = 16, 2
+        rng = np.random.default_rng(4)
+        R = _chol_upper(_spd(rng, n, np.float64))[None].astype(np.float32)
+        V = np.zeros((1, n, k), np.float32)
+        V[0, 0, 0] = np.nan
+        _, info = update_small.chol_update(
+            jnp.asarray(R), jnp.asarray(V), impl=impl)
+        assert int(np.asarray(info)[0]) != 0
+
+    def test_only_failed_problem_flags(self):
+        # batch containment: problem 0 infeasible, problem 1 clean
+        n, k = 16, 2
+        rng = np.random.default_rng(5)
+        A = np.stack([_spd(rng, n, np.float64) for _ in range(2)])
+        R = np.stack([_chol_upper(a) for a in A]).astype(np.float32)
+        W = np.stack([
+            10.0 * _chol_upper(A[0]).T[:, :k],
+            (0.1 / np.sqrt(n)) * rng.standard_normal((n, k)),
+        ]).astype(np.float32)
+        R2, info = update_small.chol_downdate(
+            jnp.asarray(R), jnp.asarray(W), impl="xla")
+        info = np.asarray(info)
+        assert info[0] != 0 and info[1] == 0
+        A1m = A[1] - np.asarray(W[1], np.float64) @ np.asarray(
+            W[1], np.float64).T
+        assert _rel_err(R2[1], A1m) < _tol(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# models/blocktri.extend == full refactor
+# ---------------------------------------------------------------------------
+
+
+class TestExtendParity:
+    def _chain(self, rng, nblocks, b, dtype=np.float32):
+        D = np.stack([_spd(rng, b, np.float64) for _ in range(nblocks)])
+        C = 0.1 * rng.standard_normal((nblocks, b, b))
+        C[0] = 0.0
+        return D.astype(dtype)[None], C.astype(dtype)[None]
+
+    @pytest.mark.parametrize("impl", ["xla", "pallas"])
+    def test_extend_equals_refactor(self, impl):
+        rng = np.random.default_rng(11)
+        nblocks, b, split = 6, 8, 4
+        D, C = self._chain(rng, nblocks, b)
+        Lf, Wtf, inf_full = blocktri.factor(
+            jnp.asarray(D), jnp.asarray(C), impl=impl)
+        assert not np.any(np.asarray(inf_full))
+        Lp, Wtp, inf_p = blocktri.factor(
+            jnp.asarray(D[:, :split]), jnp.asarray(C[:, :split]), impl=impl)
+        Ls, Wts, inf_s = blocktri.extend(
+            jnp.asarray(D[:, split:]), jnp.asarray(C[:, split:]),
+            Lp[:, -1], impl=impl)
+        assert not np.any(np.asarray(inf_p)) and not np.any(np.asarray(inf_s))
+        # the recurrence is identical step for step: bitwise equality
+        np.testing.assert_array_equal(
+            np.concatenate([np.asarray(Lp), np.asarray(Ls)], axis=1),
+            np.asarray(Lf))
+        np.testing.assert_array_equal(
+            np.concatenate([np.asarray(Wtp), np.asarray(Wts)], axis=1),
+            np.asarray(Wtf))
+
+    def test_extend_info_offset(self):
+        rng = np.random.default_rng(12)
+        nblocks, b = 4, 8
+        D, C = self._chain(rng, nblocks, b)
+        D = np.array(D)
+        D[0, 2] = -np.eye(b, dtype=np.float32)  # appended block 2 breaks
+        Lp, _, _ = blocktri.factor(
+            jnp.asarray(D[:, :1]), jnp.asarray(C[:, :1]))
+        _, _, info0 = blocktri.extend(
+            jnp.asarray(D[:, 1:]), jnp.asarray(C[:, 1:]), Lp[:, -1])
+        _, _, info_off = blocktri.extend(
+            jnp.asarray(D[:, 1:]), jnp.asarray(C[:, 1:]), Lp[:, -1],
+            offset=1 * b)
+        i0, ioff = int(np.asarray(info0)[0]), int(np.asarray(info_off)[0])
+        assert i0 != 0
+        # offset shifts the SEGMENT-relative pivot by the prefix length
+        assert ioff == i0 + 1 * b
+
+
+# ---------------------------------------------------------------------------
+# serve/factorcache.FactorCache
+# ---------------------------------------------------------------------------
+
+
+class TestFactorCache:
+    def _R(self, n, fill=1.0):
+        return jnp.asarray(np.eye(n, dtype=np.float32) * fill)
+
+    def test_put_lookup_counters(self):
+        fc = FactorCache(budget_bytes=1 << 20)
+        assert fc.lookup("a") is None
+        fc.put("a", "dense", (self._R(8),), {"n": 8})
+        ent = fc.lookup("a")
+        assert ent is not None and ent.kind == "dense"
+        st = fc.stats()
+        assert st["hits"] == 1 and st["misses"] == 1
+        assert st["installs"] == 1 and st["entries"] == 1
+        assert st["bytes"] == 8 * 8 * 4
+        assert st["hit_rate"] == pytest.approx(0.5)
+
+    def test_byte_budget_evicts_lru(self):
+        one = 8 * 8 * 4
+        fc = FactorCache(budget_bytes=2 * one)
+        fc.put("a", "dense", (self._R(8),), {})
+        fc.put("b", "dense", (self._R(8),), {})
+        assert fc.lookup("a") is not None  # refresh a: b is now LRU
+        evicted = fc.put("c", "dense", (self._R(8),), {})
+        assert evicted == ["b"]
+        assert fc.lookup("b") is None and fc.evicted("b")
+        assert fc.lookup("a") is not None and fc.lookup("c") is not None
+        st = fc.stats()
+        assert st["evictions"] == 1 and st["entries"] == 2
+        assert st["bytes"] <= st["budget_bytes"]
+
+    def test_oversized_entry_kept_newest(self):
+        # one entry over budget: everything older evicts, newest stays
+        one = 8 * 8 * 4
+        fc = FactorCache(budget_bytes=one)
+        fc.put("a", "dense", (self._R(8),), {})
+        fc.put("big", "dense", (self._R(16),), {})
+        assert fc.lookup("a") is None
+        assert fc.lookup("big") is not None
+
+    def test_release_clears_tombstone(self):
+        fc = FactorCache(budget_bytes=1 << 20)
+        fc.put("a", "dense", (self._R(8),), {})
+        assert fc.release("a") is True
+        assert fc.release("a") is False
+        assert not fc.evicted("a")  # released, not evicted: no tombstone
+        assert fc.stats()["released"] == 1
+        assert len(fc) == 0
+
+    def test_reseed_discards_tombstone(self):
+        one = 8 * 8 * 4
+        fc = FactorCache(budget_bytes=one)
+        fc.put("a", "dense", (self._R(8),), {})
+        fc.put("b", "dense", (self._R(8),), {})  # evicts a -> tombstone
+        assert fc.evicted("a")
+        fc.put("a", "dense", (self._R(8),), {})  # re-seed discards it
+        assert not fc.evicted("a")
+
+
+# ---------------------------------------------------------------------------
+# the serve wire protocol (docs/SERVING.md "Factor residency")
+# ---------------------------------------------------------------------------
+
+
+CFG = ServeConfig(
+    buckets=(16, 32),
+    rows_buckets=(64,),
+    nrhs_buckets=(2, 4),
+    nblocks_buckets=(2, 4),
+    block_buckets=(8,),
+    max_batch=2,
+    max_delay_s=0.0,
+)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return SolveEngine(cfg=CFG)
+
+
+@pytest.fixture(scope="module")
+def problem():
+    rng = np.random.default_rng(0)
+    n, k, nrhs = 16, 2, 2
+    A = _spd(rng, n)
+    B = rng.standard_normal((n, nrhs)).astype(np.float32)
+    V = ((0.1 / np.sqrt(n)) * rng.standard_normal((n, k))).astype(np.float32)
+    return n, k, A, B, V
+
+
+class TestServeResidency:
+    def test_protocol_end_to_end(self, engine, problem):
+        n, k, A, B, V = problem
+        eng = engine
+        A64 = np.asarray(A, np.float64)
+
+        # miss -> seeds; hit -> potrs-only solve
+        r = eng.solve("posv_cached", A, B, factor_token="tokA")
+        assert r.ok, r.error
+        st = eng.factor_stats()
+        assert st["misses"] >= 1 and st["installs"] >= 1
+        r2 = eng.solve("posv_cached", A, B, factor_token="tokA")
+        assert r2.ok, r2.error
+        np.testing.assert_allclose(
+            np.asarray(r2.x), np.linalg.solve(A64, B), atol=5e-4)
+
+        # rank-k update of the resident factor: client ships only V
+        r3 = eng.solve("chol_update", V, factor_token="tokA")
+        assert r3.ok, r3.error
+        A2 = A64 + np.asarray(V, np.float64) @ np.asarray(V, np.float64).T
+        assert _rel_err(np.asarray(r3.x), A2) < 5e-5
+
+        # solve against the UPDATED resident factor
+        r4 = eng.solve("posv_cached", A2.astype(np.float32), B,
+                       factor_token="tokA")
+        assert r4.ok, r4.error
+        np.testing.assert_allclose(
+            np.asarray(r4.x), np.linalg.solve(A2, B), atol=5e-4)
+
+        # downdate back to A
+        r5 = eng.solve("chol_downdate", V, factor_token="tokA")
+        assert r5.ok, r5.error
+        assert _rel_err(np.asarray(r5.x), A64) < 5e-5
+
+        # steady state: the whole mix recompiles nothing
+        before = eng.cache_stats()["compiles"]
+        for _ in range(3):
+            assert eng.solve("posv_cached", A, B, factor_token="tokA").ok
+            assert eng.solve("chol_update", V, factor_token="tokA").ok
+            assert eng.solve("chol_downdate", V, factor_token="tokA").ok
+        assert eng.cache_stats()["compiles"] == before
+
+        # the emitted record carries the factor_cache block and validates
+        rec = eng.emit_stats()
+        fc = rec["request_stats"]["factor_cache"]
+        assert fc["installs"] >= 1 and fc["hits"] >= 1
+        assert ledger.validate_request_stats(rec["request_stats"]) == []
+
+    def test_never_seeded_token_fails_loudly(self, engine, problem):
+        _, _, _, _, V = problem
+        r = engine.solve("chol_update", V, factor_token="nope")
+        assert not r.ok
+        assert "not resident" in r.error and "SERVING.md" in r.error
+
+    def test_factor_token_on_non_factor_op_rejected(self, engine, problem):
+        _, _, A, B, _ = problem
+        with pytest.raises(ValueError, match="factor_token"):
+            engine.solve("posv", A, B, factor_token="tokA")
+        with pytest.raises(ValueError, match="factor_token"):
+            engine.solve("chol_update", B)
+
+    def test_blocktri_extend_matches_refactor(self, engine):
+        rng = np.random.default_rng(21)
+        nb, b = 2, 8
+        D = np.stack([_spd(rng, b) for _ in range(2 * nb)])
+        C = (0.1 * rng.standard_normal((2 * nb, b, b))).astype(np.float32)
+        C[0] = 0.0
+        r1 = engine.solve(
+            "blocktri_extend", np.stack([D[:nb], C[:nb]]),
+            factor_token="chain1")
+        assert r1.ok, r1.error
+        r2 = engine.solve(
+            "blocktri_extend", np.stack([D[nb:], C[nb:]]),
+            factor_token="chain1")
+        assert r2.ok, r2.error
+        ent = engine.factors.peek("chain1")
+        assert ent is not None and ent.kind == "blocktri"
+        Lf, Wtf, info = blocktri.factor(
+            jnp.asarray(D, jnp.float32)[None], jnp.asarray(C)[None])
+        assert not np.any(np.asarray(info))
+        np.testing.assert_array_equal(
+            np.asarray(ent.arrays[0]), np.asarray(Lf)[0])
+        np.testing.assert_array_equal(
+            np.asarray(ent.arrays[1]), np.asarray(Wtf)[0])
+
+    def test_evicted_chain_fails_loudly(self, engine):
+        rng = np.random.default_rng(22)
+        b = 8
+        D = np.stack([_spd(rng, b) for _ in range(2)])
+        C = np.zeros((2, b, b), np.float32)
+        engine.factors._tombstones.add("chain-gone")
+        r = engine.solve("blocktri_extend", np.stack([D, C]),
+                         factor_token="chain-gone")
+        assert not r.ok and "EVICTED" in r.error
+
+
+class TestDowndateDegrade:
+    def test_infeasible_downdate_fails_loud_factor_intact(
+            self, engine, problem):
+        n, k, A, B, V = problem
+        A64 = np.asarray(A, np.float64)
+        assert engine.solve("posv_cached", A, B, factor_token="tokD").ok
+        W = (10.0 * _chol_upper(A64).T[:, :k]).astype(np.float32)
+        before = engine.factor_stats()["downdate_degrades"]
+        r = engine.solve("chol_downdate", W, factor_token="tokD")
+        assert not r.ok
+        assert "degrade refactor ALSO failed" in r.error
+        assert engine.factor_stats()["downdate_degrades"] == before + 1
+        # the resident factor survived BOTH failures untouched
+        ent = engine.factors.peek("tokD")
+        assert _rel_err(np.asarray(ent.arrays[0]), A64) < 5e-5
+
+    def test_degrade_success_installs_refactor(self, engine, problem):
+        # drive the landing sink with a simulated sweep flag: the degrade
+        # must refactor S = RᵀR − VVᵀ from the RESIDENT factor and install
+        # it with RobustInfo(escalated=1) — the recovery half of the
+        # docs/ROBUSTNESS.md downdate contract, deterministic here because
+        # the sweep itself (correctly) refuses to flag feasible problems.
+        n, k, A, B, V = problem
+        A64 = np.asarray(A, np.float64)
+        assert engine.solve("posv_cached", A, B, factor_token="tokE").ok
+        sink = engine._update_sink("chol_downdate", "tokE", n, jnp.asarray(V))
+        garbage = jnp.full((n, n), jnp.nan, jnp.float32)
+        x, info, err = sink(garbage, (), jnp.int32(3))
+        assert err is None
+        assert info.info == 0 and info.breakdown == 1 and info.escalated == 1
+        Am = A64 - np.asarray(V, np.float64) @ np.asarray(V, np.float64).T
+        ent = engine.factors.peek("tokE")
+        assert _rel_err(np.asarray(ent.arrays[0]), Am) < 5e-5
+        assert _rel_err(np.asarray(x), Am) < 5e-5
+
+    def test_update_flag_refuses_result(self, engine, problem):
+        n, k, A, B, V = problem
+        assert engine.solve("posv_cached", A, B, factor_token="tokF").ok
+        ent0 = engine.factors.peek("tokF")
+        R0 = np.asarray(ent0.arrays[0]).copy()
+        sink = engine._update_sink("chol_update", "tokF", n, jnp.asarray(V))
+        x, info, err = sink(jnp.zeros((n, n), jnp.float32), (), jnp.int32(2))
+        assert err is not None and "left unchanged" in err
+        np.testing.assert_array_equal(
+            np.asarray(engine.factors.peek("tokF").arrays[0]), R0)
+
+
+class TestCfgHashSeparation:
+    def test_factor_cache_bytes_not_in_executable_identity(self):
+        a = SolveEngine(cfg=CFG)
+        b = SolveEngine(
+            cfg=ServeConfig(**{**CFG.__dict__,
+                               "factor_cache_bytes": 1 << 30}))
+        assert a.cfg.factor_cache_bytes != b.cfg.factor_cache_bytes
+        assert a._cfg_hash == b._cfg_hash
+
+    def test_bucket_change_does_alter_identity(self):
+        a = SolveEngine(cfg=CFG)
+        c = SolveEngine(cfg=ServeConfig(**{**CFG.__dict__,
+                                           "buckets": (16, 64)}))
+        assert a._cfg_hash != c._cfg_hash
+
+
+class TestFaultContainment:
+    def test_ingest_fault_corrupts_one_request_only(self):
+        rng = np.random.default_rng(33)
+        n, k = 16, 2
+        eng = SolveEngine(cfg=CFG)
+        A1, A2 = _spd(rng, n), _spd(rng, n)
+        B = rng.standard_normal((n, 2)).astype(np.float32)
+        V = ((0.1 / np.sqrt(n))
+             * rng.standard_normal((n, k))).astype(np.float32)
+        assert eng.solve("posv_cached", A1, B, factor_token="tokX").ok
+        assert eng.solve("posv_cached", A2, B, factor_token="tokY").ok
+        RX = np.asarray(eng.factors.peek("tokX").arrays[0]).copy()
+        RY = np.asarray(eng.factors.peek("tokY").arrays[0]).copy()
+        with faultinject.active_plan(
+            faultinject.Fault(tag="serve::ingest", kind="nan"),
+        ) as plan:
+            r = eng.solve("chol_update", V, factor_token="tokX")
+        assert plan.fired == [("serve::ingest", 0)]
+        # the poisoned sweep flags; landing refuses the corrupt result
+        assert not r.ok and "left unchanged" in r.error
+        # BOTH resident factors bitwise intact, and the neighbor still
+        # serves clean updates afterwards
+        np.testing.assert_array_equal(
+            np.asarray(eng.factors.peek("tokX").arrays[0]), RX)
+        np.testing.assert_array_equal(
+            np.asarray(eng.factors.peek("tokY").arrays[0]), RY)
+        r2 = eng.solve("chol_update", V, factor_token="tokY")
+        assert r2.ok, r2.error
+
+
+# ---------------------------------------------------------------------------
+# stats / obs seams
+# ---------------------------------------------------------------------------
+
+
+def _fc_block(hits=8, misses=2, **over):
+    blk = {
+        "hits": hits, "misses": misses, "evictions": 1, "installs": 3,
+        "released": 0, "downdate_degrades": 0, "entries": 2,
+        "bytes": 1024, "budget_bytes": 4096,
+        "hit_rate": hits / (hits + misses) if hits + misses else 1.0,
+    }
+    blk.update(over)
+    return blk
+
+
+class TestStatsFactorBlock:
+    def test_block_absent_without_factor_traffic(self):
+        snap = stats.Collector().snapshot(
+            factor_cache=_fc_block(hits=0, misses=0, installs=0))
+        assert "factor_cache" not in snap
+
+    def test_block_attached_and_merged(self):
+        c = stats.Collector()
+        c.record_request("posv_cached", 0.01, ok=True)
+        s1 = c.snapshot(factor_cache=_fc_block(hits=8, misses=2))
+        s2 = c.snapshot(factor_cache=_fc_block(hits=2, misses=8))
+        merged = stats.merge_snapshots([s1, s2])
+        fc = merged["factor_cache"]
+        assert fc["hits"] == 10 and fc["misses"] == 10
+        assert fc["hit_rate"] == pytest.approx(0.5)
+        # mixed fleets: replicas without the block don't lose it
+        s3 = c.snapshot()
+        assert "factor_cache" in stats.merge_snapshots([s1, s3])
+        assert "factor_cache" not in stats.merge_snapshots([s3, s3])
+
+    def test_validate_request_stats_factor_block(self):
+        c = stats.Collector()
+        c.record_request("chol_update", 0.01, ok=True)
+        good = c.snapshot(factor_cache=_fc_block())
+        assert ledger.validate_request_stats(good) == []
+        bad = dict(good, factor_cache=_fc_block(hits=-1))
+        assert any("factor_cache.hits" in p
+                   for p in ledger.validate_request_stats(bad))
+        bad = dict(good, factor_cache=_fc_block(hit_rate=1.5))
+        assert any("hit_rate" in p
+                   for p in ledger.validate_request_stats(bad))
+        # hit_rate must be consistent with the counters it claims
+        bad = dict(good, factor_cache=_fc_block(hits=8, misses=2,
+                                                hit_rate=0.3))
+        assert any("inconsistent" in p
+                   for p in ledger.validate_request_stats(bad))
+
+
+def _update_measured(**over):
+    m = {
+        "metric": "update_speedup", "value": 0.006, "unit": "TFLOP/s",
+        "n": 1024, "k": 16, "batch": 2, "impl": "auto", "speedup": 6.0,
+        "refactor_ms": 36.0, "update_ms": 6.0,
+        "wall_ms": {"p50": 12.0, "p95": 13.0, "p99": 13.0},
+        "serve_smoke": {"requests": 50, "hit_rate": 0.92, "recompiles": 0},
+    }
+    m.update(over)
+    return m
+
+
+class TestValidateUpdateMeasured:
+    def test_valid(self):
+        assert ledger.validate_update_measured(_update_measured()) == []
+        no_smoke = _update_measured()
+        del no_smoke["serve_smoke"]
+        assert ledger.validate_update_measured(no_smoke) == []
+
+    @pytest.mark.parametrize("field,value,frag", [
+        ("n", 0, "n must be"),
+        ("impl", "cuda", "impl must be"),
+        ("speedup", -1.0, "speedup must be"),
+        ("wall_ms", {"p50": 1.0}, "wall_ms.p9"),
+        ("serve_smoke", {"requests": 50, "hit_rate": 2.0, "recompiles": 0},
+         "hit_rate"),
+    ])
+    def test_invalid(self, field, value, frag):
+        m = _update_measured(**{field: value})
+        assert any(frag in p for p in ledger.validate_update_measured(m))
+
+    def test_diff_validates_update_records(self, tmp_path):
+        rec = {"manifest": {"schema_version": ledger.SCHEMA_VERSION,
+                            "device": "cpu"},
+               "measured": _update_measured(speedup=-1.0)}
+        with pytest.raises(ledger.LedgerIncompatible, match="update"):
+            ledger.diff([rec], [rec])
+
+
+class TestServeReportResidencyGate:
+    def _emit(self, path, fc):
+        c = stats.Collector()
+        c.record_request("posv_cached", 0.01, ok=True)
+        rec = c.emit(str(path), factor_cache=fc)
+        return rec
+
+    def test_gate_passes_and_prints(self, tmp_path, capsys):
+        path = tmp_path / "serve.jsonl"
+        self._emit(path, _fc_block(hits=9, misses=1))
+        assert obs_main.main(["serve-report", str(path),
+                              "--min-residency-hit-rate", "0.9"]) == 0
+        assert "factor_cache hits=9" in capsys.readouterr().out
+
+    def test_gate_fails_below_floor(self, tmp_path, capsys):
+        path = tmp_path / "serve.jsonl"
+        self._emit(path, _fc_block(hits=1, misses=9))
+        assert obs_main.main(["serve-report", str(path),
+                              "--min-residency-hit-rate", "0.9"]) == 1
+        assert "factor-residency hit_rate" in capsys.readouterr().err
+
+    def test_gate_fails_loudly_when_block_missing(self, tmp_path, capsys):
+        path = tmp_path / "serve.jsonl"
+        c = stats.Collector()
+        c.record_request("posv", 0.01, ok=True)
+        c.emit(str(path))
+        assert obs_main.main(["serve-report", str(path),
+                              "--min-residency-hit-rate", "0.5"]) == 1
+        assert "no record carries a factor_cache block" in (
+            capsys.readouterr().err)

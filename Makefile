@@ -6,8 +6,8 @@
 PY ?= python
 
 .PHONY: all test benchmarking bench-explicit bench-small bench-blocktri \
-	bench-update tune audit lint robust serve-smoke serve-bench \
-	serve-replicas native clean
+	bench-blocktri-par bench-update tune audit lint robust serve-smoke \
+	serve-bench serve-replicas native clean
 
 all: test
 
@@ -66,6 +66,23 @@ bench-blocktri:
 		--nblocks 8 --block 16 --batch 4 --nrhs 2 --latency --calls 8 \
 		--validate --ledger bench_blocktri.jsonl
 
+# parallel chain factorization gate (docs/PERF.md round 13): the
+# partitioned (Spike) blocktri driver A/B'd against the sequential scan
+# on the same problems.  On this 1-core rig the wall-clock columns are
+# informational; the GATE is the jaxpr sequential scan-depth reduction
+# (192 -> 45 trips at nblocks=64, P=8: >= 4x) plus pinned residual
+# parity vs the sequential impl — both properties of the compiled
+# program, honest regardless of core count.  The __graft_entry__ dry run
+# then certifies the partitioned path on a real 8-device mesh (one chain
+# per device, batch·P interiors distributed) with its own residual gate.
+bench-blocktri-par:
+	rm -f bench_blocktri_par.jsonl
+	$(PY) -m capital_tpu.bench blocktri --platform cpu --dtype float32 \
+		--nblocks 64 --block 16 --batch 2 --nrhs 2 --impl partitioned \
+		--validate --min-depth-reduction 4 \
+		--ledger bench_blocktri_par.jsonl
+	$(PY) __graft_entry__.py
+
 # online factor-maintenance gate (docs/PERF.md round 12): rank-k Cholesky
 # update at the flagship serve shape (n=1024, k=16) vs refactor-from-
 # resident-state — the honest cache-less alternative: the server already
@@ -97,7 +114,8 @@ bench-update:
 # through obs trace-report — the same double-entry discipline as lint.
 # The generous 0.995 bound absorbs CPU-interpret emulation; what it pins
 # is that attribution works end to end.
-audit: serve-smoke serve-bench serve-replicas bench-blocktri bench-update lint
+audit: serve-smoke serve-bench serve-replicas bench-blocktri \
+	bench-blocktri-par bench-update lint
 	$(PY) -m capital_tpu.obs audit cholinv --n 4096 --platform cpu
 	$(PY) -m capital_tpu.obs audit cacqr --m 16384 --n 512 --platform cpu
 	$(PY) -m capital_tpu.obs robust-gate --platform cpu

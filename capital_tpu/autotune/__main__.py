@@ -86,9 +86,10 @@ def main(argv=None) -> None:
     )
     p.add_argument(
         "--impls", nargs="+", default=None,
-        choices=["vmap", "pallas", "pallas_split", "xla"],
+        choices=["vmap", "pallas", "pallas_split", "xla", "partitioned"],
         help="small: implementation axis (default all three; 'xla' is the "
-        "blocktri baseline impl, invalid for small)",
+        "blocktri baseline impl and 'partitioned' the blocktri Spike "
+        "driver, both invalid for small)",
     )
     p.add_argument(
         "--blocks", type=int, nargs="+", default=None,
@@ -122,6 +123,13 @@ def main(argv=None) -> None:
         help="blocktri: scan-segment-length axis — chain blocks per "
         "pallas_call (resolve_seg snaps each to a divisor of --nblocks; "
         "default 1 4 8)",
+    )
+    p.add_argument(
+        "--partitions", type=int, nargs="+", default=None,
+        help="blocktri: partition-count axis for --impls partitioned "
+        "(resolve_partitions snaps each to a feasible divisor of "
+        "--nblocks; 0 = the √nblocks default; duplicates after snapping "
+        "are deduped)",
     )
     p.add_argument(
         "--calls", type=int, default=32,
@@ -261,9 +269,9 @@ def main(argv=None) -> None:
                 )
         space = {}
         if args.impls:
-            if "xla" in args.impls:
-                p.error("--impls xla is the blocktri baseline impl, not a "
-                        "small axis (vmap/pallas/pallas_split)")
+            if any(i in ("xla", "partitioned") for i in args.impls):
+                p.error("--impls xla/partitioned are blocktri impls, not "
+                        "small axes (vmap/pallas/pallas_split)")
             space["impls"] = tuple(args.impls)
         if args.blocks:
             space["blocks"] = tuple(args.blocks)
@@ -314,12 +322,15 @@ def main(argv=None) -> None:
         space = {}
         if args.impls:
             if any(i in ("vmap", "pallas_split") for i in args.impls):
-                p.error("blocktri impls are 'xla' and 'pallas' only")
+                p.error("blocktri impls are 'xla', 'pallas' and "
+                        "'partitioned' only")
             space["impls"] = tuple(args.impls)
         if args.blocks:
             space["blocks"] = tuple(args.blocks)
         if args.segs:
             space["segs"] = tuple(args.segs)
+        if args.partitions:
+            space["partitions"] = tuple(args.partitions)
         grid = Grid.square(c=1, devices=dev[:1])
         res = sweep.tune_blocktri(
             grid, args.nblocks, args.block, batch=args.batch,
@@ -340,6 +351,7 @@ def main(argv=None) -> None:
             ("--bc", bool(args.bc)),
             ("--buckets", bool(args.buckets)),
             ("--segs", bool(args.segs)),
+            ("--partitions", bool(args.partitions)),
         ):
             if given:
                 p.error(

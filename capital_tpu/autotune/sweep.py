@@ -756,6 +756,7 @@ def blocktri_space(
     impls: Iterable[str] = ("xla", "pallas"),
     blocks: Iterable[int] = (0,),
     segs: Iterable[int] = (1, 4, 8),
+    partitions: Iterable[int] = (0,),
 ):
     """impl x block-unroll x scan-segment-length for the block-tridiagonal
     chain (models/blocktri): the knobs that shape the scan-of-Pallas-blocks
@@ -763,18 +764,25 @@ def blocktri_space(
     and chain blocks per pallas_call (`seg`, launch amortization vs the
     VMEM step envelope).  The xla scan ignores both knobs (it scans one
     block per step through lax.linalg), so that impl contributes ONE
-    baseline config rather than a degenerate axis product.  `B_rhs` rides
-    as a closure so the swept operand stays the single packed A array
-    (batch, 2, nblocks, b, b) — A[:, 0] the diagonal blocks, A[:, 1] the
-    couplings, the serve bucket packing."""
+    baseline config rather than a degenerate axis product.  The
+    'partitioned' impl (the round-13 Spike driver) sweeps the partitions
+    x block-unroll plane instead: `partitions` snaps through
+    resolve_partitions (so 0 is the √nblocks default and infeasible
+    requests collapse — duplicates are deduped rather than re-measured),
+    and `seg` is NOT an axis there (the interior fold already amortizes
+    launches across batch·P problems; its inner scans keep the resolved
+    default).  `B_rhs` rides as a closure so the swept operand stays the
+    single packed A array (batch, 2, nblocks, b, b) — A[:, 0] the
+    diagonal blocks, A[:, 1] the couplings, the serve bucket packing."""
     from capital_tpu.models import blocktri
     from capital_tpu.ops import batched_small
 
     prec = None if jnp.dtype(dtype).itemsize < 4 else "highest"
     for impl in impls:
-        if impl not in ("xla", "pallas"):
+        if impl not in ("xla", "pallas", "partitioned"):
             raise ValueError(
-                f"blocktri_space: impl must be 'xla' or 'pallas', got {impl!r}"
+                "blocktri_space: impl must be 'xla', 'pallas' or "
+                f"'partitioned', got {impl!r}"
             )
         if impl == "xla":
             def step(a):
@@ -782,6 +790,29 @@ def blocktri_space(
                                      precision=prec, impl="xla")
 
             yield "xla", {"impl": "xla"}, step
+            continue
+        if impl == "partitioned":
+            seen_p = set()
+            for part in partitions:
+                p_eff = blocktri.resolve_partitions(nblocks, part)
+                for blk in blocks:
+                    blk_eff = blk or batched_small.pick_block(b)
+                    if (p_eff, blk_eff) in seen_p:
+                        continue
+                    seen_p.add((p_eff, blk_eff))
+
+                    def step(a, blk=blk, part=p_eff):
+                        return blocktri.posv(
+                            a[:, 0], a[:, 1], B_rhs, block=blk,
+                            precision=prec, impl="partitioned",
+                            partitions=part)
+
+                    yield (
+                        f"part_p{p_eff}_b{blk_eff}",
+                        {"impl": "partitioned", "partitions": p_eff,
+                         "block": blk_eff},
+                        step,
+                    )
             continue
         for blk in blocks:
             blk_eff = blk or batched_small.pick_block(b)

@@ -967,8 +967,32 @@ def blocktri(args) -> dict:
         impl = "xla"
     (Dj, Cj, Bj), (Dn, Cn, Bn) = _blocktri_batch(nblocks, b, batch, nrhs,
                                                  dtype)
-    fn = jax.jit(
-        lambda d, c, rhs: bt_mod.posv(d, c, rhs, precision=prec, impl=impl)
+    partitions = 0
+    seq_impl = impl
+    if impl == "partitioned":
+        # the A/B satellite: bench the partitioned driver against the
+        # sequential scan on the SAME problems, and measure the thing the
+        # algorithm actually buys — jaxpr sequential scan depth (the
+        # critical path a 1-core rig can still count honestly even when
+        # wall time can't show the parallel win).  Inner scans obey the
+        # same off-TPU honest-wall pin as 'auto' above.
+        partitions = bt_mod.resolve_partitions(nblocks, args.partitions)
+        inner = "xla" if jax.default_backend() != "tpu" else "auto"
+        seq_impl = "xla" if jax.default_backend() != "tpu" else "pallas"
+        fn = jax.jit(
+            lambda d, c, rhs: bt_mod.posv(
+                d, c, rhs, precision=prec, impl="partitioned",
+                partitions=partitions, partition_inner=inner,
+            )
+        )
+    else:
+        fn = jax.jit(
+            lambda d, c, rhs: bt_mod.posv(d, c, rhs, precision=prec,
+                                          impl=impl)
+        )
+    seq_fn = fn if impl != "partitioned" else jax.jit(
+        lambda d, c, rhs: bt_mod.posv(d, c, rhs, precision=prec,
+                                      impl=seq_impl)
     )
 
     if args.validate:
@@ -989,9 +1013,12 @@ def blocktri(args) -> dict:
         )
         _gate("blocktri_solve_residual", worst, tol)
         # factor residual: reconstruct A from (L, Wt) blockwise in f64 —
-        # ‖A − L̃·L̃ᵀ‖_F/‖A‖_F over the whole batch
+        # ‖A − L̃·L̃ᵀ‖_F/‖A‖_F over the whole batch.  factor() is the
+        # sequential representation (it rejects 'partitioned'), so the
+        # reconstruction rides seq_impl; the partitioned X was already
+        # residual-gated above, which is the contract that matters.
         L, Wt, _ = jax.jit(
-            lambda d, c: bt_mod.factor(d, c, precision=prec, impl=impl)
+            lambda d, c: bt_mod.factor(d, c, precision=prec, impl=seq_impl)
         )(Dj, Cj)
         Ln = np.asarray(L, np.float64)
         Wn = np.asarray(Wt, np.float64).transpose(0, 1, 3, 2)  # W_i
@@ -1037,6 +1064,11 @@ def blocktri(args) -> dict:
             "nblocks": nblocks, "block": b, "n": n, "batch": batch,
             "nrhs": nrhs, "impl": impl, "calls": args.calls,
         }
+        if impl == "partitioned":
+            from capital_tpu.obs import xla_audit
+
+            rec["partitions"] = partitions
+            rec["depth"] = xla_audit.sequential_depth(fn, Dj, Cj, Bj)
         import json as _json
 
         print(_json.dumps(rec))
@@ -1049,6 +1081,39 @@ def blocktri(args) -> dict:
         lambda: fn(Dj, Cj, Bj), calls=max(args.iters, 3), warmup=3
     )
     t = sum(samples) / len(samples)
+
+    par_extra: dict = {}
+    if impl == "partitioned":
+        # A/B rows vs the sequential scan: latency AND jaxpr sequential
+        # scan depth — the depth column is the honest metric on a 1-core
+        # rig (wall time can't show the parallel win when the P interior
+        # factorizations time-slice one core; the shortened critical path
+        # is a property of the program, not the host).
+        from capital_tpu.obs import xla_audit
+
+        depth = xla_audit.sequential_depth(fn, Dj, Cj, Bj)
+        depth_seq = xla_audit.sequential_depth(seq_fn, Dj, Cj, Bj)
+        depth_reduction = depth_seq / depth if depth else 0.0
+        Xp, _ = jax.block_until_ready(fn(Dj, Cj, Bj))
+        Xs, _ = jax.block_until_ready(seq_fn(Dj, Cj, Bj))
+        scale = max(float(jnp.max(jnp.abs(Xs))), 1e-30)
+        parity = float(jnp.max(jnp.abs(Xp - Xs))) / scale
+        sseq = harness.latency_samples(
+            lambda: seq_fn(Dj, Cj, Bj), calls=max(args.iters, 3), warmup=3
+        )
+        t_seq = sum(sseq) / len(sseq)
+        print(f"# impl={seq_impl:<12s} {t_seq / batch * 1e3:9.3f} "
+              f"ms/problem  depth={depth_seq}")
+        print(f"# impl=partitioned  {t / batch * 1e3:9.3f} ms/problem  "
+              f"depth={depth}  (P={partitions}, "
+              f"{depth_reduction:.2f}x shallower, parity {parity:.2e})")
+        par_extra = {
+            "partitions": partitions, "depth": depth,
+            "depth_seq": depth_seq,
+            "depth_reduction": round(depth_reduction, 3),
+            "parity": parity,
+            "seq_ms": round(t_seq / batch * 1e3, 4),
+        }
 
     # dense comparison on the same problems, per-problem amortized both
     # sides; the dense batch shrinks when batch·n² won't reasonably fit
@@ -1078,7 +1143,27 @@ def blocktri(args) -> dict:
         dense_ms=round(t_dense / dense_batch * 1e3, 3),
         wall_ms={k: round(v * 1e3, 4)
                  for k, v in harness.percentiles(samples).items()},
+        **par_extra,
     )
+    if args.min_depth_reduction:
+        if impl != "partitioned":
+            sys.exit("--min-depth-reduction requires --impl partitioned")
+        ptol = _tolerance(dtype)
+        if parity > ptol or depth_reduction < args.min_depth_reduction:
+            _ledger_append(args, rec, name="blocktri", grid=grid,
+                           dtype=dtype,
+                           cfg={"op": "posv_blocktri", "impl": impl,
+                                "nblocks": nblocks, "block": b})
+            if parity > ptol:
+                sys.exit(
+                    f"partitioned parity gate failed: max|X_par - X_seq| "
+                    f"= {parity:.2e} > {ptol:g} vs impl={seq_impl}"
+                )
+            sys.exit(
+                f"depth gate failed: {depth_reduction:.2f}x < "
+                f"{args.min_depth_reduction}x "
+                f"(seq {depth_seq} trips -> partitioned {depth})"
+            )
     if args.min_speedup and speedup < args.min_speedup:
         _ledger_append(args, rec, name="blocktri", grid=grid, dtype=dtype,
                        cfg={"op": "posv_blocktri", "impl": impl,
@@ -1426,10 +1511,26 @@ def build_parser() -> argparse.ArgumentParser:
         "n = nblocks * block)",
     )
     p.add_argument(
-        "--impl", default="auto", choices=["auto", "pallas", "xla"],
+        "--impl", default="auto",
+        choices=["auto", "pallas", "xla", "partitioned"],
         help="blocktri: chain implementation; auto = pallas scan on TPU, "
         "xla scan elsewhere (off-TPU pallas is the interpreter — serve "
-        "keeps it there for AOT-cache persistability, a bench must not)",
+        "keeps it there for AOT-cache persistability, a bench must not); "
+        "partitioned = the Spike chain driver, benched A/B against the "
+        "sequential scan with latency + jaxpr-depth columns",
+    )
+    p.add_argument(
+        "--partitions", type=int, default=0,
+        help="blocktri --impl partitioned: requested partition count "
+        "(0 = resolve_partitions default, the largest divisor of nblocks "
+        "<= sqrt(nblocks); requests decrement to a valid divisor)",
+    )
+    p.add_argument(
+        "--min-depth-reduction", type=float, default=0.0,
+        help="blocktri --impl partitioned: fail the run when the measured "
+        "jaxpr sequential scan-depth reduction vs the sequential impl "
+        "lands below this factor (the round-13 gate: 4 at nblocks=64) or "
+        "when partitioned results drift past the pinned parity tolerance",
     )
     p.add_argument(
         "--min-speedup", type=float, default=0.0,

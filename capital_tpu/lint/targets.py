@@ -21,7 +21,8 @@ import jax.numpy as jnp
 from capital_tpu.lint.program import ProgramTarget
 
 TARGET_NAMES = ("cholinv", "cacqr", "serve", "batched_small", "serve_sched",
-                "cholinv_fused", "blocktri", "update_small")
+                "cholinv_fused", "blocktri", "blocktri_partitioned",
+                "update_small")
 
 
 def _grid():
@@ -168,6 +169,37 @@ def blocktri_target(
     )
 
 
+def blocktri_partitioned_target(
+    nblocks: int = 8, b: int = 8, nrhs: int = 2, capacity: int = 2,
+    partitions: int = 2, dtype=jnp.float32,
+) -> ProgramTarget:
+    """The partitioned-bucket serve posv_blocktri program (ServeConfig.
+    blocktri_impl='partitioned' through api.batched — the executable an
+    engine configured for the Spike driver compiles): the concurrent
+    interior factor+widened solve and the parallel back-substitution
+    under ``BT::partition``, the interface Schur assembly + reduced
+    P-block chain under ``BT::reduce`` — both new phase tags under the
+    phase-coverage rule, alongside the sequential target's ``BT::factor``
+    / ``BT::solve`` which the reduced chain still emits.  Forced
+    impl='pallas' so the widened interior scans ride the kernel route
+    serve routes on TPU (partition_inner maps from the kernel flavor);
+    ``flops_audited=False`` for the same interpret-rig reason as
+    blocktri_target.  No donation (same shape argument)."""
+    from capital_tpu.serve import api
+
+    dt = jnp.dtype(dtype)
+    a_sds = jax.ShapeDtypeStruct((capacity, 2, nblocks, b, b), dt)
+    b_sds = jax.ShapeDtypeStruct((capacity, nblocks, b, nrhs), dt)
+    return ProgramTarget(
+        name=(f"serve-blocktri-par-b{capacity}-nb{nblocks}-bs{b}"
+              f"-p{partitions}"),
+        fn=api.batched("posv_blocktri", impl="pallas",
+                       blocktri_impl="partitioned",
+                       blocktri_partitions=partitions),
+        args=(a_sds, b_sds), flops_audited=False,
+    )
+
+
 def update_small_target(
     n: int = 64, k: int = 4, capacity: int = 8, dtype=jnp.float32,
 ) -> ProgramTarget:
@@ -292,6 +324,8 @@ def flagship_targets(names=None) -> list[ProgramTarget]:
             out.append(cholinv_fused_target())
         elif name == "blocktri":
             out.append(blocktri_target())
+        elif name == "blocktri_partitioned":
+            out.append(blocktri_partitioned_target())
         elif name == "update_small":
             out.append(update_small_target())
         else:

@@ -1,0 +1,138 @@
+"""Symmetric banded systems on the blocktri fast path (round 13).
+
+A symmetric positive-definite banded matrix with bandwidth ``u`` (``u``
+sub/super-diagonals) IS a block-tridiagonal chain once re-blocked at any
+block size ``b >= u``: every entry ``A[p, q]`` with ``|p - q| <= u`` lands
+either inside a diagonal block ``D_i`` or inside the coupling ``C_i``
+between ADJACENT blocks — never further, which is exactly the chain
+contract ``models/blocktri`` factors at O(nblocks·b³).  This module is
+the thin adapter: gather the LAPACK-style band storage into ``(D, C)``
+chain blocks (a vectorized index map, no Python loop over n), pad the
+tail block's diagonal with identity rows so the chain length divides,
+and ride ``blocktri.posv`` unchanged — sequential scan or the
+partitioned Spike driver, whichever the dispatch picks for the geometry.
+
+Band storage follows ``scipy.linalg.solveh_banded`` exactly (the parity
+test's reference): ``ab`` has shape ``(u + 1, n)``; in LOWER form
+``ab[d, i] = A[i + d, i]`` (main diagonal in row 0), in UPPER form
+``ab[u + i - j, j] = A[i, j]`` for ``i <= j`` (main diagonal in the last
+row).  The identity padding keeps the padded matrix SPD and the padded
+solution rows exactly zero for zero RHS rows, so un-padding is a slice.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from capital_tpu.models import blocktri
+
+__all__ = ["resolve_block", "to_blocktri", "solveh_banded"]
+
+#: default re-blocking size floor: blocks this small under-fill even the
+#: CPU scan steps; the bandwidth still wins when it is larger.
+_MIN_BLOCK = 8
+
+
+def resolve_block(u: int, n: int, block: int = 0) -> int:
+    """The chain block size a bandwidth-``u`` re-blocking uses: any
+    ``b >= max(u, 1)`` is correct (couplings then never span more than
+    one block boundary); the default takes ``max(u, 8)`` capped at ``n``
+    so a narrow band still forms reasonably sized scan steps.  An
+    explicit ``block`` below the bandwidth is an error, not a silent
+    widening — the caller sized a bucket with it."""
+    if block:
+        if block < max(u, 1):
+            raise ValueError(
+                f"banded: block {block} is below the bandwidth {u} — "
+                "couplings would span non-adjacent blocks"
+            )
+        return block
+    return max(u, _MIN_BLOCK, 1) if n >= _MIN_BLOCK else max(u, 1, n)
+
+
+def _lower_form(ab, lower: bool):
+    """Canonicalize band storage to LOWER form (``ab[d, i] = A[i+d, i]``).
+
+    The upper form stores ``A[i, j] = ab[u + i - j, j]`` (i <= j); the
+    lower entry ``A[i + d, i] = A[i, i + d]`` therefore sits at
+    ``ab[u - d, i + d]`` — a diagonal-wise roll, vectorized here."""
+    ab = jnp.asarray(ab)
+    if ab.ndim != 2:
+        raise ValueError(f"banded: ab must be 2-D (u+1, n), got {ab.shape}")
+    if lower:
+        return ab
+    u, n = ab.shape[0] - 1, ab.shape[1]
+    d = jnp.arange(u + 1)[:, None]
+    i = jnp.arange(n)[None, :]
+    src = jnp.clip(i + d, 0, n - 1)
+    return jnp.where(i + d < n, ab[u - d, src], 0)
+
+
+def to_blocktri(ab, *, lower: bool = False, block: int = 0):
+    """Re-block band storage into the blocktri chain ``(D, C, n)``.
+
+    Returns ``D (nblocks, b, b)``, ``C (nblocks, b, b)`` (``C[0] = 0``,
+    ``C[i]`` couples block i to i−1 — the chain convention) and the
+    original order ``n``; ``nblocks·b >= n`` with identity rows padding
+    the tail block's diagonal.  Pure gather: ``D_i[r, c] =
+    ab[|r−c|, i·b + min(r, c)]`` and ``C_i[r, c] = ab[b + r − c,
+    (i−1)·b + c]``, each masked to the band."""
+    ab = _lower_form(ab, lower)
+    u, n = ab.shape[0] - 1, ab.shape[1]
+    if n == 0:
+        raise ValueError("banded: empty operand (n = 0)")
+    b = resolve_block(u, n, block)
+    nblocks = -(-n // b)
+    pad = nblocks * b - n
+    abp = jnp.pad(ab, ((0, 0), (0, pad)))
+    r = jnp.arange(b)[:, None]
+    c = jnp.arange(b)[None, :]
+    i = jnp.arange(nblocks)[:, None, None]
+    # diagonal blocks: band row |r−c|, band column at the block offset
+    dband = jnp.abs(r - c)
+    dcol = i * b + jnp.minimum(r, c)
+    D = jnp.where(dband <= u, abp[jnp.minimum(dband, u), dcol], 0)
+    # identity on padded diagonal rows keeps the chain SPD and the
+    # padded solution rows at exactly zero for zero RHS rows
+    D = D + jnp.where((i * b + r >= n) & (r == c),
+                      jnp.ones((), abp.dtype), 0)
+    # couplings: A[i·b + r, (i−1)·b + c] sits on band row b + r − c,
+    # which is inside the band only for the block's upper-right corner
+    cband = b + r - c
+    ccol = jnp.clip((i - 1) * b + c, 0, nblocks * b - 1)
+    C = jnp.where((cband <= u) & (i >= 1),
+                  abp[jnp.minimum(cband, u), ccol], 0)
+    return D, C, n
+
+
+def solveh_banded(ab, rhs, *, lower: bool = False, block: int = 0,
+                  **posv_kwargs):
+    """Solve the SPD banded system — ``scipy.linalg.solveh_banded``'s
+    calling convention on the blocktri fast path.  ``rhs`` is ``(n,)`` or
+    ``(n, k)``; returns ``x`` of the same shape.  Extra keyword arguments
+    flow to ``blocktri.posv`` unchanged (impl / partitions /
+    partition_inner / precision — so a banded solve can ride the
+    partitioned driver exactly like a native chain).  Raises on reported
+    breakdown like scipy (the chain's global potrf info, mapped to the
+    padded order's first failing leading minor)."""
+    D, C, n = to_blocktri(ab, lower=lower, block=block)
+    rhs = jnp.asarray(rhs, D.dtype)
+    squeeze = rhs.ndim == 1
+    if squeeze:
+        rhs = rhs[:, None]
+    if rhs.shape[0] != n:
+        raise ValueError(
+            f"banded: rhs has {rhs.shape[0]} rows, operand order is {n}"
+        )
+    nblocks, b = D.shape[0], D.shape[1]
+    Bp = jnp.pad(rhs, ((0, nblocks * b - n), (0, 0)))
+    Bp = Bp.reshape(nblocks, b, rhs.shape[1])
+    X, info = blocktri.posv(D[None], C[None], Bp[None], **posv_kwargs)
+    bad = int(info[0])
+    if bad:
+        raise ValueError(
+            f"banded: leading minor of order {bad} is not positive "
+            "definite (blocktri posv info)"
+        )
+    x = X[0].reshape(nblocks * b, rhs.shape[1])[:n]
+    return x[:, 0] if squeeze else x

@@ -36,18 +36,51 @@ nsteps times.  Per-block breakdown info min-combines to one global
 LAPACK-convention pivot index via `robust.detect.combine_block_infos`
 (block i's local 0/k/b+1 maps to global 0/(i·b+k)/(n+1)), so RobustInfo
 and fault containment work per block.
+
+`posv(impl='partitioned')` replaces the O(nblocks) sequential critical
+path with the Spike / one-level cyclic-reduction decomposition (the
+partitioned chain factorization of 2601.03754, the multi-device story of
+JAXMg 2601.14466): the chain splits into P partitions whose LAST block
+is a separator, the P interior chains (m−1 = nblocks/P − 1 blocks each)
+factor CONCURRENTLY with the partition axis folded into the batched
+grid (batch·P problems per pallas_call / scan step), one widened
+substitution pass produces the local solutions g = A_p⁻¹b_p and the two
+spikes Φ = A_p⁻¹F_p, Ψ = A_p⁻¹G_p, the P-block reduced interface system
+(a block-tridiagonal SPD Schur complement over the separators) rides
+the EXISTING sequential scan, and back-substitution is one batched gemm
+pair — sequential depth O(nblocks/P + P) against the scan's O(nblocks),
+work still O(nblocks·b³) plus the spike widening.  Phases:
+`BT::partition` (interiors + back-substitution), `BT::reduce` (interface
+assembly + reduced chain).
 """
 
 from __future__ import annotations
 
+import math
+
 import jax
 import jax.numpy as jnp
 
-from capital_tpu.ops import blocktri_small
+from capital_tpu.ops import blocktri_small, pallas_tpu
 from capital_tpu.robust import detect
 from capital_tpu.utils import tracing
 
-IMPLS = ("auto", "pallas", "xla")
+IMPLS = ("auto", "pallas", "xla", "partitioned")
+
+# auto resolves to 'partitioned' only above this chain length: below it the
+# reduced-system overhead (spike widening + P-block interface solve) eats
+# the depth win — the PR 6 "auto picks the winner, forcing is explicit"
+# contract, measured in docs/PERF.md round 13
+PARTITION_MIN_NBLOCKS = 16
+
+# inner-impl vocabulary of the partitioned driver (the sequential scans it
+# runs per partition interior and on the reduced chain)
+PARTITION_INNER = ("auto", "pallas", "xla")
+
+# the serve-side ALGORITHM vocabulary (ServeConfig.blocktri_impl): which
+# chain algorithm posv_blocktri buckets compile — orthogonal to the kernel
+# flavor the serve-wide impl picks
+ALGORITHMS = ("auto", "scan", "partitioned")
 
 
 def resolve_seg(nblocks: int, seg: int = 0) -> int:
@@ -59,6 +92,24 @@ def resolve_seg(nblocks: int, seg: int = 0) -> int:
     while nblocks % s:
         s -= 1
     return max(s, 1)
+
+
+def resolve_partitions(nblocks: int, partitions: int = 0) -> int:
+    """Partition count for impl='partitioned': a divisor of nblocks (the
+    separators are the last block of every partition, so the P interior
+    chains stay uniform for batch-folding) with at least one interior
+    block per partition (m = nblocks/P ≥ 2).  A requested value
+    decrements to the nearest valid divisor (the `resolve_seg` idiom —
+    the autotune space sweeps this knob); the default is the largest
+    valid divisor ≤ √nblocks, balancing the P-step reduced chain against
+    the m-step interiors (8 at the flagship nblocks=64).  Returns 1 when
+    the chain cannot split (nblocks < 4, or prime) — the caller falls
+    back to the sequential scan."""
+    cap = nblocks // 2
+    p = min(partitions or math.isqrt(nblocks), cap)
+    while p > 1 and nblocks % p:
+        p -= 1
+    return max(p, 1)
 
 
 def _steps(X, nsteps: int, seg: int):
@@ -87,11 +138,45 @@ def _check_chain(D, C, B=None, op="blocktri"):
                 f"{D.shape}, got {B.shape}")
 
 
+def _partitioned_auto(nblocks: int, partitions: int, dtype) -> bool:
+    """Does `auto` resolve to the partitioned driver?  Only when the
+    split exists AND amortizes: an explicit `partitions` request opts in
+    at any length, otherwise the chain must clear PARTITION_MIN_NBLOCKS.
+    f64 keeps the sequential xla scan under auto (the PR 6 contract —
+    forcing impl='partitioned' is the explicit opt-in there, and its
+    inner scans resolve to the exact-dtype xla path, no downgrade)."""
+    if not blocktri_small.dtype_capable(dtype):
+        return False
+    if resolve_partitions(nblocks, partitions) < 2:
+        return False
+    return bool(partitions) or nblocks >= PARTITION_MIN_NBLOCKS
+
+
 def _resolve_impl(impl: str, dtype, b: int, k: int, seg: int,
-                  interpret) -> str:
+                  interpret, *, nblocks: int = 0, partitions: int = 0,
+                  allow_partitioned: bool = False, op: str = "blocktri") -> str:
     if impl not in IMPLS:
         raise ValueError(f"blocktri impl must be one of {IMPLS}, got {impl!r}")
+    if impl == "partitioned":
+        if not allow_partitioned:
+            # factor/solve/extend carry the sequential (L, Wt)
+            # representation across the call boundary; the partitioned
+            # driver's spikes never materialize it — only the fused posv
+            # can ride the split
+            raise ValueError(
+                f"{op}: impl='partitioned' is a posv-only algorithm (the "
+                "factored representation is sequential); use posv() or "
+                "impl in ('auto', 'pallas', 'xla')")
+        if resolve_partitions(nblocks, partitions) < 2:
+            # chain too short (or prime) to split — sequential semantics,
+            # exact dtype, same resolve-don't-raise shape as f64 pallas
+            return blocktri_small.default_impl(b, k, seg, dtype,
+                                               interpret=interpret)
+        return impl
     if impl == "auto":
+        if allow_partitioned and _partitioned_auto(nblocks, partitions,
+                                                   dtype):
+            return "partitioned"
         return blocktri_small.default_impl(b, k, seg, dtype,
                                            interpret=interpret)
     if impl == "pallas" and not blocktri_small.dtype_capable(dtype):
@@ -100,6 +185,22 @@ def _resolve_impl(impl: str, dtype, b: int, k: int, seg: int,
         # precision the caller paid for — fall back like api._batched_pallas
         return "xla"
     return impl
+
+
+def posv_algorithm(nblocks: int, dtype, *, impl: str = "auto",
+                   partitions: int = 0) -> str:
+    """Which ALGORITHM `posv()` runs for this geometry: 'partitioned' or
+    'scan'.  Static resolution (shapes/dtypes only — the zero-recompile
+    invariant), shared by the serve engine's impl-split stats and the
+    bench driver's A/B labeling."""
+    if impl not in IMPLS:
+        raise ValueError(f"blocktri impl must be one of {IMPLS}, got {impl!r}")
+    if impl == "partitioned":
+        return ("partitioned"
+                if resolve_partitions(nblocks, partitions) >= 2 else "scan")
+    if impl == "auto" and _partitioned_auto(nblocks, partitions, dtype):
+        return "partitioned"
+    return "scan"
 
 
 def _combine(infos, nblocks: int, b: int, offset: int = 0):
@@ -140,8 +241,15 @@ def _tri_solve(L, R, transpose: bool = False):
     custom call); a batched LU solve stays on LAPACK custom calls and
     runs ~4.5x faster, so the CPU rig takes that route — same solution,
     the operand is exactly triangular either way.  TPU/GPU keep the
-    native triangular_solve."""
-    if jax.default_backend() == "cpu":
+    native triangular_solve.
+
+    The platform probe rides `pallas_tpu._platform()` — the mesh/grid
+    scope stack when one is active, the process default backend only
+    outside any scope — because `jax.default_backend()` at trace time
+    initializes the process-default client, which the hermetic dryrun
+    contract forbids (a CPU-mesh dry run in a TPU-default process must
+    never touch the TPU client; tests/test_multichip_hermetic.py)."""
+    if pallas_tpu._platform() == "cpu":
         A = jnp.swapaxes(L, -1, -2) if transpose else L
         return jnp.linalg.solve(A, R)
     return jax.lax.linalg.triangular_solve(
@@ -287,6 +395,149 @@ def _pallas_fused_forward(D, C, B, *, seg, block, precision, interpret):
 
 
 # --------------------------------------------------------------------------
+# partitioned (Spike / one-level cyclic-reduction) driver
+# --------------------------------------------------------------------------
+
+
+def _scan_posv(D, C, B, impl, *, seg, block, precision, interpret):
+    """Raw sequential fused posv: (X, per-block infos (batch, nblocks)).
+    No scopes, no emits, no info combining — the partitioned driver runs
+    this on the folded interiors and on the reduced chain and prices both
+    itself (its phase split is partition/reduce, not factor/solve)."""
+    if impl == "pallas":
+        L, Wt, Y, infos = _pallas_fused_forward(
+            D, C, B, seg=seg, block=block, precision=precision,
+            interpret=interpret)
+        X = _pallas_backward_scan(
+            L, Wt, Y, seg=seg, block=block, precision=precision,
+            interpret=interpret)
+    else:
+        L, Wt, infos = _xla_factor_scan(D, C, precision)
+        Y = _xla_forward_scan(L, Wt, B, precision)
+        X = _xla_backward_scan(L, Wt, Y, precision)
+    return X, infos
+
+
+def _combine_partitioned(infos_in, infos_red, nblocks, b, P, m):
+    """Map partition-relative per-block infos to ONE whole-chain potrf
+    status: interior block j of partition p sits at global block p·m + j,
+    separator p at global block p·m + m − 1 (the PR 12 `extend` offset
+    idiom — each tail's `dest` is its global diagonal offset, and
+    `combine_block_infos` min-combines with the drop-polluted-windows
+    first pass).
+
+    One pollution edge is BACKWARD and must be masked before the
+    min-combine: the Schur assembly subtracts E_{p+1}ᵀ·Φ_{p+1} from
+    separator p's reduced diagonal, and a broken interior p + 1 turns
+    that update into NaN even through zero couplings (0·NaN = NaN) —
+    while separator p precedes partition p + 1's interior in chain
+    order.  Left alone, the min would report separator p as a spuriously
+    EARLIER first-bad pivot than the sequential scan does.  So a reduced
+    candidate at separator p is dropped whenever interior p + 1 is
+    broken; interior p + 1's own (true, later) position wins instead.
+    The cost: if separator p is ALSO genuinely indefinite in that case
+    we report the interior's position rather than the separator's — the
+    two breakdowns are indistinguishable post-NaN, and the reported
+    pivot still flags a genuinely broken leading minor."""
+    n = nblocks * b
+    start = jnp.zeros(infos_in.shape[:1], jnp.int32)
+    red = [infos_red[:, p] for p in range(P)]
+    for p in range(P - 1):
+        next_broken = infos_in[:, p + 1].max(axis=-1) > 0
+        red[p] = jnp.where(next_broken, 0, red[p])
+    tails = []
+    for p in range(P):
+        for j in range(m - 1):
+            tails.append(((p * m + j) * b, b, infos_in[:, p, j]))
+        tails.append(((p * m + m - 1) * b, b, red[p]))
+    return detect.combine_block_infos(start, tails, n)
+
+
+def _partitioned_posv(D, C, B, *, partitions, inner, block, seg,
+                      precision, interpret):
+    """The Spike decomposition (docstring at module top).  Separators are
+    the LAST block of every partition: s_p = p·m + m − 1, interiors
+    J_p = blocks p·m .. p·m + m − 2.  One widened interior substitution
+    pass at RHS [B | F | G] (k + 2b columns) yields the local solutions
+    and both spikes; the reduced interface system over the P separators
+    is itself block-tridiagonal SPD and rides the ordinary sequential
+    scan."""
+    batch, nblocks, b, _ = D.shape
+    k = B.shape[-1]
+    P = partitions
+    m = nblocks // P
+    prec = precision
+
+    Dr = D.reshape(batch, P, m, b, b)
+    Cr = C.reshape(batch, P, m, b, b)
+    Br = B.reshape(batch, P, m, b, k)
+    E = Cr[:, :, 0]            # cross-partition coupling into block p·m
+    Csep = Cr[:, :, m - 1]     # separator s_p ← its own interior tail
+    Dsep, Bsep = Dr[:, :, m - 1], Br[:, :, m - 1]
+
+    with tracing.scope("BT::partition"):
+        tracing.emit(flops=batch * tracing.blocktri_partition_flops(
+            nblocks, b, k, P))
+        # interior chains, partition axis folded into the batch axis —
+        # this is the concurrency: batch·P independent (m−1)-block chains
+        # per scan step / pallas grid
+        Din = Dr[:, :, :m - 1].reshape(batch * P, m - 1, b, b)
+        Cin = (Cr[:, :, :m - 1].at[:, :, 0].set(0)
+               .reshape(batch * P, m - 1, b, b))
+        # widened RHS [B | F | G]: F_p = E_p in the FIRST interior block,
+        # G_p = C_{s_p}ᵀ in the LAST (the two column-blocks whose solves
+        # are the spikes Φ_p = A_p⁻¹F_p, Ψ_p = A_p⁻¹G_p); E_0 is dead
+        # (C[:, 0] zeroed), so Φ_0 = 0 falls out for free
+        R = jnp.zeros((batch, P, m - 1, b, k + 2 * b), B.dtype)
+        R = R.at[..., :k].set(Br[:, :, :m - 1])
+        R = R.at[:, :, 0, :, k:k + b].set(E)
+        R = R.at[:, :, m - 2, :, k + b:].set(jnp.swapaxes(Csep, -1, -2))
+        segi = resolve_seg(m - 1, seg)
+        Sol, infos_in = _scan_posv(
+            Din, Cin, R.reshape(batch * P, m - 1, b, k + 2 * b),
+            inner, seg=segi, block=block, precision=prec,
+            interpret=interpret)
+        Sol = Sol.reshape(batch, P, m - 1, b, k + 2 * b)
+        g, Phi, Psi = Sol[..., :k], Sol[..., k:k + b], Sol[..., k + b:]
+
+    with tracing.scope("BT::reduce"):
+        tracing.emit(flops=batch * tracing.blocktri_reduce_flops(P, b, k))
+        # Schur complement over the separators: eliminate the interiors.
+        # S[p,p]   = D_{s_p} − C_{s_p}·Ψ_p[last] − E_{p+1}ᵀ·Φ_{p+1}[first]
+        # S[p,p−1] = −C_{s_p}·Φ_p[last]            (dead at p = 0)
+        # b̃_p      = B_{s_p} − C_{s_p}·g_p[last] − E_{p+1}ᵀ·g_{p+1}[first]
+        ET = jnp.swapaxes(E, -1, -2)
+        Sd = Dsep - jnp.einsum("zpij,zpjk->zpik", Csep, Psi[:, :, m - 2],
+                               precision=prec)
+        Sd = Sd.at[:, :P - 1].add(-jnp.einsum(
+            "zpij,zpjk->zpik", ET[:, 1:], Phi[:, 1:, 0], precision=prec))
+        Ct = -jnp.einsum("zpij,zpjk->zpik", Csep, Phi[:, :, m - 2],
+                         precision=prec)
+        Ct = Ct.at[:, 0].set(0)
+        bt = Bsep - jnp.einsum("zpij,zpjk->zpik", Csep, g[:, :, m - 2],
+                               precision=prec)
+        bt = bt.at[:, :P - 1].add(-jnp.einsum(
+            "zpij,zpjk->zpik", ET[:, 1:], g[:, 1:, 0], precision=prec))
+        xsep, infos_red = _scan_posv(
+            Sd, Ct, bt, inner, seg=resolve_seg(P, seg), block=block,
+            precision=prec, interpret=interpret)
+
+    with tracing.scope("BT::partition"):
+        # back-substitution — batched gemm pair per partition, no scans:
+        # x_{J_p} = g_p − Φ_p·x_{s_{p−1}} − Ψ_p·x_{s_p}
+        xprev = jnp.concatenate(
+            [jnp.zeros_like(xsep[:, :1]), xsep[:, :-1]], axis=1)
+        Xin = (g
+               - jnp.einsum("zpaij,zpjk->zpaik", Phi, xprev, precision=prec)
+               - jnp.einsum("zpaij,zpjk->zpaik", Psi, xsep, precision=prec))
+        X = jnp.concatenate([Xin, xsep[:, :, None]], axis=2)
+        X = X.reshape(batch, nblocks, b, k)
+
+    infos_in = infos_in.reshape(batch, P, m - 1)
+    return X, _combine_partitioned(infos_in, infos_red, nblocks, b, P, m)
+
+
+# --------------------------------------------------------------------------
 # public API
 # --------------------------------------------------------------------------
 
@@ -304,7 +555,8 @@ def factor(D, C, *, block: int = 0, seg: int = 0,
     _check_chain(D, C, op="blocktri factor")
     batch, nblocks, b, _ = D.shape
     seg = resolve_seg(nblocks, seg)
-    impl = _resolve_impl(impl, D.dtype, b, b, seg, interpret)
+    impl = _resolve_impl(impl, D.dtype, b, b, seg, interpret,
+                         op="blocktri factor")
     C = _zero_first_coupling(C)
     with tracing.scope("BT::factor"):
         tracing.emit(flops=batch * tracing.blocktri_chol_flops(nblocks, b))
@@ -347,7 +599,8 @@ def extend(D, C, L_last, *, block: int = 0, seg: int = 0,
             f"blocktri extend: L_last must be (batch, b, b) = "
             f"({batch}, {b}, {b}) riding D {D.shape}, got {L_last.shape}")
     seg = resolve_seg(nblocks, seg)
-    impl = _resolve_impl(impl, D.dtype, b, b, seg, interpret)
+    impl = _resolve_impl(impl, D.dtype, b, b, seg, interpret,
+                         op="blocktri extend")
     with tracing.scope("UP::extend"):
         tracing.emit(flops=batch * tracing.blocktri_chol_flops(nblocks, b))
         if impl == "pallas":
@@ -370,7 +623,8 @@ def solve(L, Wt, B, *, block: int = 0, seg: int = 0,
     batch, nblocks, b, _ = L.shape
     k = B.shape[-1]
     seg = resolve_seg(nblocks, seg)
-    impl = _resolve_impl(impl, B.dtype, b, k, seg, interpret)
+    impl = _resolve_impl(impl, B.dtype, b, k, seg, interpret,
+                         op="blocktri solve")
     with tracing.scope("BT::solve"):
         tracing.emit(
             flops=batch * 2 * tracing.blocktri_solve_flops(nblocks, b, k))
@@ -389,18 +643,49 @@ def solve(L, Wt, B, *, block: int = 0, seg: int = 0,
 
 def posv(D, C, B, *, block: int = 0, seg: int = 0,
          precision: str | None = "highest", impl: str = "auto",
-         interpret: bool | None = None):
+         interpret: bool | None = None, partitions: int = 0,
+         partition_inner: str = "auto"):
     """FUSED factor + solve of the block-tridiagonal chain: the factor
     scan consumes each L_i for the forward sweep while it is VMEM-resident
     (one fused kernel per scan step — the serve `posv_blocktri` op), then
     the backward sweep finishes.  Returns (X, info): X (batch, nblocks,
-    b, k), info (batch,) int32 global potrf status."""
+    b, k), info (batch,) int32 global potrf status.
+
+    impl='partitioned' (or 'auto' above PARTITION_MIN_NBLOCKS) runs the
+    Spike decomposition instead of the sequential scan — same (X, info)
+    contract, sequential depth O(nblocks/P + P).  `partitions` requests
+    the split count (0 → resolve_partitions default; the autotune axis);
+    `partition_inner` picks the scan flavor of the interior/reduced
+    chains ('auto' resolves per `blocktri_small.partition_inner_impl` —
+    the VMEM gate at the widened spike RHS; f64 interiors ride the exact-
+    dtype xla scan, so forcing 'partitioned' never downgrades
+    precision)."""
     _check_chain(D, C, B, op="blocktri posv")
     batch, nblocks, b, _ = D.shape
     k = B.shape[-1]
     seg = resolve_seg(nblocks, seg)
-    impl = _resolve_impl(impl, D.dtype, b, k, seg, interpret)
+    impl = _resolve_impl(impl, D.dtype, b, k, seg, interpret,
+                         nblocks=nblocks, partitions=partitions,
+                         allow_partitioned=True, op="blocktri posv")
     C = _zero_first_coupling(C)
+    if impl == "partitioned":
+        if partition_inner not in PARTITION_INNER:
+            raise ValueError(
+                f"blocktri posv: partition_inner must be one of "
+                f"{PARTITION_INNER}, got {partition_inner!r}")
+        P = resolve_partitions(nblocks, partitions)
+        if partition_inner == "auto":
+            inner = blocktri_small.partition_inner_impl(
+                b, k, resolve_seg(nblocks // P - 1, seg), D.dtype,
+                interpret=interpret)
+        elif (partition_inner == "pallas"
+              and not blocktri_small.dtype_capable(D.dtype)):
+            inner = "xla"  # the same no-silent-downgrade gate as above
+        else:
+            inner = partition_inner
+        return _partitioned_posv(
+            D, C, B, partitions=P, inner=inner, block=block, seg=seg,
+            precision=precision, interpret=interpret)
     with tracing.scope("BT::factor"):
         # fused factor + forward sweep: one phase, one price
         tracing.emit(

@@ -255,6 +255,13 @@ def _serve_report(args) -> int:
             " ops " + " ".join(f"{k}={ops[k]}" for k in sorted(ops))
             if ops else ""
         )
+        # posv_blocktri algorithm split (scan vs partitioned Spike driver
+        # — Collector.blocktri_impls); absent without blocktri traffic
+        bti = rs.get("blocktri_impls")
+        bti_note = (
+            " blocktri " + " ".join(f"{k}={bti[k]}" for k in sorted(bti))
+            if bti else ""
+        )
         print(
             f"# [{i}] {man.get('platform', '?')}/{man.get('device', '?')} "
             f"requests={rs['requests']} ok={rs['ok']} "
@@ -264,7 +271,7 @@ def _serve_report(args) -> int:
             f"queue_max={rs['queue_depth_max']} "
             f"cache hits={cache['hits']} misses={cache['misses']} "
             f"hit_rate={cache['hit_rate']:.3f}"
-            + small_note + split_note + ops_note + fc_note
+            + small_note + split_note + ops_note + bti_note + fc_note
         )
         if (args.min_hit_rate is not None
                 and cache["hit_rate"] < args.min_hit_rate):

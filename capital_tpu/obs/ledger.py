@@ -303,6 +303,26 @@ def validate_request_stats(block) -> list[str]:
                     probs.append(
                         f"ops[{name!r}] must be a non-negative int, got {v!r}"
                     )
+    # optional posv_blocktri algorithm split (PR 13 — Collector
+    # .blocktri_impls): which chain driver the compiled programs ran,
+    # 'scan' vs 'partitioned'.  Absent without blocktri traffic; when
+    # present, keys must come from that two-word vocabulary.
+    if "blocktri_impls" in block:
+        bti = block["blocktri_impls"]
+        if not isinstance(bti, dict):
+            probs.append(f"blocktri_impls must be an object, got {bti!r}")
+        else:
+            for name, v in bti.items():
+                if name not in ("scan", "partitioned"):
+                    probs.append(
+                        f"blocktri_impls key {name!r} is not a chain "
+                        "algorithm ('scan', 'partitioned')"
+                    )
+                if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                    probs.append(
+                        f"blocktri_impls[{name!r}] must be a non-negative "
+                        f"int, got {v!r}"
+                    )
     # optional percentile blocks, validated whenever present, same posture
     # as the rest of the block:
     #   latency_ms_small — small-N split (serve small_n_impl pallas
@@ -496,7 +516,7 @@ def validate_phase_seconds(measured) -> list[str]:
 
 
 #: blocktri chain impls the bench driver can report (models/blocktri.IMPLS).
-_BLOCKTRI_IMPLS = ("auto", "pallas", "xla")
+_BLOCKTRI_IMPLS = ("auto", "pallas", "xla", "partitioned")
 
 
 def validate_blocktri_measured(measured) -> list[str]:
@@ -537,6 +557,27 @@ def validate_blocktri_measured(measured) -> list[str]:
             for p in _REQ_STATS_PCTS:
                 if not isinstance(wm.get(p), (int, float)):
                     probs.append(f"wall_ms.{p} missing or non-numeric")
+    # partitioned-driver fields (PR 13): optional — present on rows the
+    # driver ran with --impl partitioned (and on the sequential A/B
+    # baseline rows, which carry `depth` only).  When present they must
+    # be well-formed: partitions a positive int, the depth trio positive
+    # (depth/depth_seq are jaxpr scan-trip counts, depth_reduction their
+    # ratio — the ≥4x gate of `make bench-blocktri-par`).
+    if "partitions" in measured:
+        p = measured["partitions"]
+        if not isinstance(p, int) or isinstance(p, bool) or p < 1:
+            probs.append(f"partitions must be a positive int, got {p!r}")
+    for key in ("depth", "depth_seq"):
+        if key in measured:
+            d = measured[key]
+            if not isinstance(d, int) or isinstance(d, bool) or d < 1:
+                probs.append(f"{key} must be a positive int, got {d!r}")
+    if "depth_reduction" in measured:
+        dr = measured["depth_reduction"]
+        if (not isinstance(dr, (int, float)) or isinstance(dr, bool)
+                or not dr > 0):
+            probs.append(
+                f"depth_reduction must be a positive number, got {dr!r}")
     return probs
 
 

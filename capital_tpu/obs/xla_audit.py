@@ -388,3 +388,58 @@ def audit_and_drift(
         a, rec, tol_ratio=tol_ratio, slack=slack,
         flops_tol_ratio=flops_tol_ratio,
     )
+
+
+# --------------------------------------------------------------------------
+# sequential scan-depth (jaxpr trip-length count — docs/PERF.md round 13)
+# --------------------------------------------------------------------------
+
+
+def _sub_jaxprs(params: dict):
+    """Every sub-jaxpr hanging off an eqn's params (pjit/scan/cond/custom
+    derivatives all stash theirs under different keys — structural duck
+    typing beats a primitive-name switch across jax versions)."""
+    for v in params.values():
+        vs = v if isinstance(v, (tuple, list)) else (v,)
+        for w in vs:
+            if hasattr(w, "eqns"):
+                yield w
+            elif hasattr(w, "jaxpr") and hasattr(w.jaxpr, "eqns"):
+                yield w.jaxpr
+
+
+def scan_depth(jaxpr) -> int:
+    """Total `lax.scan` trip count along the program: each scan contributes
+    length × max(1, depth of its body), nested control flow recursed
+    (cond branches take the max — only one executes).  This is the honest
+    sequential-depth metric on the 1-core CI rig, where wall-clock cannot
+    distinguish a 192-step chain from a 45-step one: scans are the ONLY
+    sequential construct these programs emit, every trip is a dependent
+    step, and independent work (the partitioned interiors) folds into the
+    batch axis of a single scan rather than adding trips.  The bench
+    driver's depth column and `make bench-blocktri-par`'s ≥4x reduction
+    gate both read this."""
+    jx = jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr
+    depth = 0
+    for eqn in jx.eqns:
+        subs = list(_sub_jaxprs(eqn.params))
+        name = eqn.primitive.name
+        if name == "scan":
+            length = int(eqn.params.get("length", 1))
+            inner = max((scan_depth(s) for s in subs), default=0)
+            depth += length * max(inner, 1)
+        elif name == "cond":
+            depth += max((scan_depth(s) for s in subs), default=0)
+        else:
+            depth += sum(scan_depth(s) for s in subs)
+    return depth
+
+
+def sequential_depth(fn: Callable, *args) -> int:
+    """`scan_depth` of ``fn(*args)``'s jaxpr.  Fresh wrapper per call for
+    the same trace-cache reason as `trace_model`."""
+
+    def _fresh(*a):
+        return fn(*a)
+
+    return scan_depth(jax.make_jaxpr(_fresh)(*args))

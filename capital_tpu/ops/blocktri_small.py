@@ -70,6 +70,7 @@ from capital_tpu.ops.batched_small import (
 __all__ = [
     "step_eligible",
     "default_impl",
+    "partition_inner_impl",
     "fused_forward_step",
     "factor_step",
     "forward_solve_step",
@@ -111,6 +112,19 @@ def default_impl(b: int, k: int, seg: int, dtype,
     return ("pallas"
             if step_eligible(b, k, seg, dtype, interpret=interpret)
             else "xla")
+
+
+def partition_inner_impl(b: int, k: int, seg: int, dtype,
+                         *, interpret: bool | None = None) -> str:
+    """Resolve the INNER impl of the partitioned (Spike) chain driver:
+    its interior chains substitute a widened RHS [B | F | G] of k + 2b
+    columns (the two spike column-blocks ride the same sweep as the local
+    solutions), so the VMEM step envelope must be checked at that width —
+    a chain whose sequential posv is pallas-eligible at width k can still
+    overflow the step budget once the spikes widen it.  Same f64 → xla
+    gate as `default_impl`; the partition axis folds into the batch axis
+    of the grid, which costs no VMEM per step."""
+    return default_impl(b, k + 2 * b, seg, dtype, interpret=interpret)
 
 
 # --------------------------------------------------------------------------

@@ -59,7 +59,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from capital_tpu.models import blocktri, cholesky, qr
-from capital_tpu.ops import batched_small, lapack, update_small
+from capital_tpu.ops import batched_small, blocktri_small, lapack, update_small
 from capital_tpu.parallel import summa
 from capital_tpu.utils import tracing
 
@@ -177,7 +177,8 @@ def _batched_pallas(op: str, precision, split: bool):
     return f
 
 
-def _batched_blocktri(precision, impl: str):
+def _batched_blocktri(precision, impl: str, blocktri_impl: str = "auto",
+                      partitions: int = 0):
     """The block-tridiagonal bucket program: unpack the (batch, 2,
     nblocks, b, b) chain packing (A[:, 0] = diagonal blocks, A[:, 1] =
     sub-diagonal blocks) and run the fused scan-of-Pallas-blocks posv
@@ -186,15 +187,38 @@ def _batched_blocktri(precision, impl: str):
     own: 'vmap' means the pure lax.linalg scan ('xla' — there is no
     per-problem LAPACK route for the chain), 'pallas_split' means
     'pallas' (the chain has no split form; the scan IS the split).
-    Resolution reads only static shapes/dtypes (models/blocktri
-    ._resolve_impl, incl. the f64-always-xla gate), so the engine's
-    zero-recompile invariant holds."""
+
+    `blocktri_impl` is the ALGORITHM knob (ServeConfig.blocktri_impl,
+    config-hashed): 'partitioned' forces the Spike driver with the
+    serve-wide impl picking its inner scan flavor, 'scan' pins the
+    sequential scan even where posv's auto would split, 'auto' leaves
+    the choice to models/blocktri (auto kernel flavor only — a forced
+    'pallas'/'vmap' engine keeps today's sequential program).  All
+    resolution reads only static shapes/dtypes (models/blocktri
+    ._resolve_impl incl. the f64-always-xla gate, resolve_partitions),
+    so the engine's zero-recompile invariant holds."""
     mapped = {"auto": "auto", "pallas": "pallas",
               "pallas_split": "pallas", "vmap": "xla"}[impl]
+    if blocktri_impl not in blocktri.ALGORITHMS:
+        raise ValueError(
+            f"unknown blocktri_impl {blocktri_impl!r}: expected one of "
+            f"{blocktri.ALGORITHMS}")
 
     def f(a, b):
+        if blocktri_impl == "partitioned":
+            return blocktri.posv(a[:, 0], a[:, 1], b, precision=precision,
+                                 impl="partitioned", partitions=partitions,
+                                 partition_inner=mapped)
+        if blocktri_impl == "scan" and mapped == "auto":
+            # pin the sequential algorithm but keep per-bucket kernel
+            # resolution: static-shape trace-time pick, like auto()
+            nblocks, bs = a.shape[2], a.shape[3]
+            pick = blocktri_small.default_impl(
+                bs, b.shape[-1], blocktri.resolve_seg(nblocks), a.dtype)
+            return blocktri.posv(a[:, 0], a[:, 1], b,
+                                 precision=precision, impl=pick)
         return blocktri.posv(a[:, 0], a[:, 1], b, precision=precision,
-                             impl=mapped)
+                             impl=mapped, partitions=partitions)
 
     return f
 
@@ -297,7 +321,8 @@ def _batched_extend(precision, impl: str):
 
 
 def batched(op: str, precision: str | None = "highest",
-            impl: str = "auto"):
+            impl: str = "auto", *, blocktri_impl: str = "auto",
+            blocktri_partitions: int = 0):
     """The function the engine AOT-compiles for one bucket: maps the fixed
     (capacity, *problem) batch through the per-problem kernel, returning
     (X, info) stacks.
@@ -307,6 +332,9 @@ def batched(op: str, precision: str | None = "highest",
     batched-grid factor + solve, two launches), or 'auto' (resolve per
     bucket from the static batch shapes at trace time — small VMEM-
     eligible posv/lstsq buckets go pallas, everything else vmap).
+    `blocktri_impl` / `blocktri_partitions` reach only the posv_blocktri
+    program (`_batched_blocktri` — the partitioned-vs-scan algorithm
+    knob; config-hashed by the engine).
     """
     if impl not in batched_small.IMPLS:
         raise ValueError(
@@ -314,7 +342,8 @@ def batched(op: str, precision: str | None = "highest",
             f"{batched_small.IMPLS}"
         )
     if op == "posv_blocktri":
-        return _batched_blocktri(precision, impl)
+        return _batched_blocktri(precision, impl, blocktri_impl,
+                                 blocktri_partitions)
     if op in ("chol_update", "chol_downdate"):
         return _batched_update(op, precision, impl)
     if op == "posv_cached":
@@ -407,9 +436,11 @@ def single(op: str, grid, precision: str | None = "highest", robust=None,
 
         return f
     if op == "posv_blocktri":
-        # oversize chains run as a batch of one through the same scan
-        # paths (there is no distributed blocktri schedule — the chain is
-        # sequential; `grid` is accepted for signature uniformity).
+        # oversize chains run as a batch of one through the models
+        # dispatch — impl='auto' picks the partitioned (Spike) driver
+        # above PARTITION_MIN_NBLOCKS, exactly where oversize chains
+        # live, cutting the critical path the batch of one cannot hide
+        # (`grid` is accepted for signature uniformity).
         def f(a, b):
             X, info = blocktri.posv(a[None, 0], a[None, 1], b[None],
                                     precision=precision)

@@ -81,6 +81,19 @@ class ServeConfig:
         padded blocks embed diag(D_i, I)).  Both join the config hash
         with the dense ladders — the blocktri buckets AOT-cache alongside
         dense buckets under the same discipline.
+    blocktri_impl: which chain ALGORITHM the posv_blocktri bucket
+        programs compile (models/blocktri.ALGORITHMS): 'auto' lets
+        posv's dispatch pick (the partitioned Spike driver above
+        PARTITION_MIN_NBLOCKS when the kernel flavor is auto too),
+        'partitioned' forces the split, 'scan' pins the sequential scan.
+        Joins the config hash — a partitioned and a sequential engine
+        compile different programs and must never share cache entries.
+    blocktri_partitions: requested partition count for the partitioned
+        chain driver (0 = resolve_partitions default, the largest
+        divisor of nblocks ≤ √nblocks; requests decrement to a valid
+        divisor per bucket).  Joins the config hash for the same reason
+        — the partition count is baked into every compiled chain
+        program's geometry.
     max_batch: per-bucket batch capacity — one executable per bucket at
         this fixed batch size; also the submit-time flush threshold.
     max_delay_s: oldest-request age that forces a flush at pump() — the
@@ -133,6 +146,8 @@ class ServeConfig:
     nrhs_buckets: tuple[int, ...] = (1, 8, 64)
     nblocks_buckets: tuple[int, ...] = (8, 32, 64)
     block_buckets: tuple[int, ...] = (32, 64, 128)
+    blocktri_impl: str = "auto"
+    blocktri_partitions: int = 0
     max_batch: int = 8
     max_delay_s: float = 0.005
     precision: Optional[str] = "highest"
@@ -160,6 +175,16 @@ class SolveEngine:
             raise ValueError(
                 f"unknown small_n_impl {cfg.small_n_impl!r}: expected one "
                 f"of {batched_small.IMPLS}"
+            )
+        if cfg.blocktri_impl not in blocktri.ALGORITHMS:
+            raise ValueError(
+                f"unknown blocktri_impl {cfg.blocktri_impl!r}: expected "
+                f"one of {blocktri.ALGORITHMS}"
+            )
+        if cfg.blocktri_partitions < 0:
+            raise ValueError(
+                f"blocktri_partitions must be >= 0, got "
+                f"{cfg.blocktri_partitions}"
             )
         if cfg.scheduler not in SCHEDULERS:
             raise ValueError(
@@ -196,7 +221,8 @@ class SolveEngine:
         ident = repr((cfg.buckets, cfg.rows_buckets, cfg.nrhs_buckets,
                       cfg.nblocks_buckets, cfg.block_buckets,
                       cfg.max_batch, cfg.precision, cfg.robust,
-                      cfg.small_n_impl, cfg.tail_fuse_depth))
+                      cfg.small_n_impl, cfg.tail_fuse_depth,
+                      cfg.blocktri_impl, cfg.blocktri_partitions))
         self._cfg_hash = hashlib.sha1(ident.encode()).hexdigest()[:12]
         self._grid_key = (self.grid.dx, self.grid.dy, self.grid.c,
                           self.grid.platform)
@@ -258,6 +284,25 @@ class SolveEngine:
             bucket.op, a_shape, b_shape, bucket.dtype
         ) == "pallas"
 
+    def _blocktri_algorithm(self, nblocks: int, dtype) -> str:
+        """Which chain algorithm a posv_blocktri bucket program runs —
+        'scan' or 'partitioned' — re-derived from the same static
+        resolution api._batched_blocktri makes at trace time, so the
+        stats collector's impl split (serve-report's `blocktri` note)
+        reflects the compiled reality, not the request."""
+        if self.cfg.blocktri_impl == "partitioned":
+            return blocktri.posv_algorithm(
+                nblocks, dtype, impl="partitioned",
+                partitions=self.cfg.blocktri_partitions)
+        if self.cfg.blocktri_impl == "scan":
+            return "scan"
+        if self.cfg.small_n_impl != "auto":
+            # a forced kernel flavor pins the sequential program under
+            # blocktri_impl='auto' (api._batched_blocktri)
+            return "scan"
+        return blocktri.posv_algorithm(
+            nblocks, dtype, partitions=self.cfg.blocktri_partitions)
+
     def _resolve_bucket(self, bucket: batching.Bucket) -> tuple:
         """The scheduler's get_exe callback: (executable, small_route)."""
         return self._get_batched(bucket), self._small_route(bucket)
@@ -274,7 +319,9 @@ class SolveEngine:
                 specs.append(jax.ShapeDtypeStruct(
                     (bucket.capacity,) + bucket.b_shape, dt))
             fn = api.batched(bucket.op, self.cfg.precision,
-                             self.cfg.small_n_impl)
+                             self.cfg.small_n_impl,
+                             blocktri_impl=self.cfg.blocktri_impl,
+                             blocktri_partitions=self.cfg.blocktri_partitions)
             exe = jax.jit(fn, donate_argnums=dn).lower(*specs).compile()
             if self.validate and dn:
                 from capital_tpu.lint import program as lint_program
@@ -417,6 +464,14 @@ class SolveEngine:
             op, A.shape, B.shape if B is not None else None,
             str(A.dtype), self.cfg,
         )
+        if op == "posv_blocktri":
+            # impl split: the bucketed program follows the engine's
+            # algorithm knobs; the oversize single route runs posv's own
+            # defaults (api.single), so it is counted that way
+            self.stats.note_blocktri_impl(
+                self._blocktri_algorithm(bucket.a_shape[1], bucket.dtype)
+                if bucket is not None
+                else blocktri.posv_algorithm(A.shape[1], A.dtype))
         if bucket is None:
             if self.cfg.oversize == "reject":
                 self.executor.fail(

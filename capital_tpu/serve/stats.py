@@ -53,8 +53,17 @@ class Collector:
         # faults, rejects) contribute to neither.
         self.queue_waits_s: list[float] = []
         self.devices_s: list[float] = []
+        # posv_blocktri algorithm split ('scan' vs 'partitioned' — which
+        # chain driver the request's compiled program runs, resolved by
+        # the engine at submit time from static geometry).  Optional
+        # block, like latency_ms_small: absent until blocktri traffic
+        # happens.
+        self.blocktri_impls: Counter = Counter()
 
     # ---- feeding -----------------------------------------------------------
+
+    def note_blocktri_impl(self, algorithm: str) -> None:
+        self.blocktri_impls[algorithm] += 1
 
     def note_queue_depth(self, depth: int) -> None:
         self.queue_depth_max = max(self.queue_depth_max, depth)
@@ -153,6 +162,12 @@ class Collector:
                 k: round(v * 1e3, 4)
                 for k, v in percentiles(self.devices_s).items()
             }
+        # posv_blocktri scan/partitioned split: same optional-block
+        # discipline — absent without blocktri traffic, so older records
+        # keep their schema and `obs serve-report` prints it only where
+        # it means something.
+        if self.blocktri_impls:
+            snap["blocktri_impls"] = dict(self.blocktri_impls)
         if factor_cache and (factor_cache.get("hits", 0)
                              + factor_cache.get("misses", 0)
                              + factor_cache.get("installs", 0)) > 0:
@@ -244,8 +259,10 @@ def merge_snapshots(snaps: list[dict]) -> dict:
     if not snaps:
         raise ValueError("merge_snapshots needs at least one snapshot")
     ops: Counter = Counter()
+    bt_impls: Counter = Counter()
     for s in snaps:
         ops.update(s.get("ops") or {})
+        bt_impls.update(s.get("blocktri_impls") or {})
     batches = sum(int(s.get("batches", 0)) for s in snaps)
     occ_w = sum(float(s.get("batch_occupancy_mean", 0.0))
                 * int(s.get("batches", 0)) for s in snaps)
@@ -265,6 +282,8 @@ def merge_snapshots(snaps: list[dict]) -> dict:
         ),
         "replicas": len(snaps),
     }
+    if bt_impls:
+        merged["blocktri_impls"] = dict(bt_impls)
     ids = [s["replica_id"] for s in snaps if s.get("replica_id")]
     if ids:
         merged["replica_ids"] = sorted(ids)

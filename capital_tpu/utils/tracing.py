@@ -171,6 +171,17 @@ PHASE_REGISTRY: tuple[str, ...] = (
     # BT::factor: the scan body executes nsteps times, the price fires
     # once).
     "UP::update", "UP::downdate", "UP::extend",
+    # partitioned (Spike / one-level cyclic-reduction) chain solve
+    # (models/blocktri.py impl='partitioned', docs/PERF.md round 13).
+    # BT::partition wraps the embarrassingly-parallel per-partition work —
+    # the interior factor + widened [B | F | G] spike solves with the
+    # partition axis folded into the batched grid, and the final
+    # back-substitution — priced whole via blocktri_partition_flops.
+    # BT::reduce wraps the P-block interface system: the Schur assembly
+    # gemms plus the sequential reduced-chain posv
+    # (blocktri_reduce_flops).  Same outside-the-scan emit rationale as
+    # BT::factor.
+    "BT::partition", "BT::reduce",
 )
 _PHASE_SET: set[str] = set(PHASE_REGISTRY)
 
@@ -531,6 +542,34 @@ def blocktri_solve_flops(nblocks: int, b: int, k: int) -> float:
     width k plus the 2b²k off-diagonal coupling product.  A full potrs
     analog is two of these."""
     return nblocks * (batched_trsm_flops(b, k) + 2.0 * b**2 * k)
+
+
+def blocktri_partition_flops(nblocks: int, b: int, k: int,
+                             partitions: int) -> float:
+    """Per-partition side of the partitioned (Spike) chain solve, per
+    problem (BT::partition): the `nblocks − P` interior blocks factor
+    once, run BOTH substitution sweeps at the widened RHS [B | Φ-cols |
+    Ψ-cols] of k + 2b columns (the spike solves ride the same sweep as
+    the local solutions), and the back-substitution applies the two
+    (b, b) spike blocks to each interior solution (4b²k per block).
+    Sequential-depth is O(nblocks/P); the WORK stays O(nblocks·b³) plus
+    the spike widening — this price is what the bench driver's A/B row
+    shows the depth win costs in executed flops."""
+    interior = nblocks - partitions
+    return (blocktri_chol_flops(interior, b)
+            + 2.0 * blocktri_solve_flops(interior, b, k + 2 * b)
+            + 4.0 * interior * b**2 * k)
+
+
+def blocktri_reduce_flops(partitions: int, b: int, k: int) -> float:
+    """Reduced interface system of the partitioned chain solve, per
+    problem (BT::reduce): per separator, the Schur assembly gemms (three
+    (b, b)·(b, b) products into the reduced diagonal/coupling, 6b³, plus
+    two (b, b)·(b, k) RHS corrections, 4b²k), then the P-block reduced
+    chain runs the ordinary sequential factor + both sweeps."""
+    asm = partitions * (6.0 * b**3 + 4.0 * b**2 * k)
+    return (asm + blocktri_chol_flops(partitions, b)
+            + 2.0 * blocktri_solve_flops(partitions, b, k))
 
 
 def chol_update_flops(n: int, k: int) -> float:

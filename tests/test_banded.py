@@ -1,0 +1,123 @@
+"""Banded→blocktri adapter tests (ISSUE 13 satellite): parity against
+``scipy.linalg.solveh_banded`` in BOTH storage forms, re-blocking
+geometry (padding, block validation), breakdown mapping, and the
+partitioned-driver ride-along the adapter exists for."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+scipy_linalg = pytest.importorskip("scipy.linalg")
+
+from capital_tpu.models import banded
+
+
+def _spd_band(rng, n, u):
+    """Lower-form band storage of a well-conditioned SPD banded matrix
+    (gram of a banded factor, diagonally dominated)."""
+    A = np.zeros((n, n))
+    for d in range(u + 1):
+        v = rng.standard_normal(n - d) * (0.4 ** d)
+        A += np.diag(v, -d)
+    A = A @ A.T + (u + 1) * np.eye(n)
+    ab = np.zeros((u + 1, n))
+    for d in range(u + 1):
+        ab[d, : n - d] = np.diag(A, -d)
+    return ab, A
+
+
+def _upper_form(ab):
+    u, n = ab.shape[0] - 1, ab.shape[1]
+    up = np.zeros_like(ab)
+    for d in range(u + 1):
+        up[u - d, d:] = ab[d, : n - d]
+    return up
+
+
+class TestReblocking:
+    @pytest.mark.parametrize("n,u,block", [(32, 3, 0), (30, 5, 8),
+                                           (17, 2, 4), (8, 1, 0)])
+    def test_chain_reassembles_the_band(self, n, u, block):
+        rng = np.random.default_rng(140)
+        ab, A = _spd_band(rng, n, u)
+        D, C, n_out = banded.to_blocktri(jnp.asarray(ab), lower=True,
+                                         block=block)
+        assert n_out == n
+        nblocks, b = D.shape[0], D.shape[1]
+        assert nblocks * b >= n and b >= u
+        dense = np.zeros((nblocks * b, nblocks * b))
+        for i in range(nblocks):
+            s = i * b
+            dense[s:s + b, s:s + b] = np.asarray(D[i])
+            if i:
+                dense[s:s + b, s - b:s] = np.asarray(C[i])
+                dense[s - b:s, s:s + b] = np.asarray(C[i]).T
+        np.testing.assert_allclose(dense[:n, :n], A, rtol=0, atol=1e-12)
+        # identity padding beyond n, nothing else
+        np.testing.assert_allclose(dense[n:, n:],
+                                   np.eye(nblocks * b - n), atol=0)
+        assert np.all(dense[n:, :n] == 0)
+
+    def test_block_below_bandwidth_rejected(self):
+        rng = np.random.default_rng(141)
+        ab, _ = _spd_band(rng, 16, 5)
+        with pytest.raises(ValueError, match="below the bandwidth"):
+            banded.to_blocktri(jnp.asarray(ab), lower=True, block=4)
+
+    def test_resolve_block_policy(self):
+        assert banded.resolve_block(3, 64) == 8    # floor wins
+        assert banded.resolve_block(12, 64) == 12  # bandwidth wins
+        assert banded.resolve_block(3, 64, 16) == 16
+        assert banded.resolve_block(1, 4) == 4     # capped by n
+
+
+class TestSolveParity:
+    @pytest.mark.parametrize("n,u", [(32, 3), (30, 5), (17, 2)])
+    @pytest.mark.parametrize("lower", [True, False])
+    def test_matches_scipy(self, n, u, lower):
+        rng = np.random.default_rng(142)
+        ab, _ = _spd_band(rng, n, u)
+        rhs = rng.standard_normal((n, 2))
+        store = ab if lower else _upper_form(ab)
+        ref = scipy_linalg.solveh_banded(store, rhs, lower=lower)
+        x = banded.solveh_banded(jnp.asarray(store), jnp.asarray(rhs),
+                                 lower=lower)
+        np.testing.assert_allclose(np.asarray(x), ref, rtol=0, atol=1e-10)
+
+    def test_1d_rhs_round_trips_shape(self):
+        rng = np.random.default_rng(143)
+        ab, _ = _spd_band(rng, 20, 2)
+        rhs = rng.standard_normal(20)
+        x = banded.solveh_banded(jnp.asarray(ab), jnp.asarray(rhs),
+                                 lower=True)
+        assert x.shape == (20,)
+        np.testing.assert_allclose(
+            np.asarray(x), scipy_linalg.solveh_banded(ab, rhs, lower=True),
+            rtol=0, atol=1e-10)
+
+    def test_rhs_row_mismatch_rejected(self):
+        rng = np.random.default_rng(144)
+        ab, _ = _spd_band(rng, 16, 2)
+        with pytest.raises(ValueError, match="rows"):
+            banded.solveh_banded(jnp.asarray(ab), jnp.zeros((8, 1)),
+                                 lower=True)
+
+    def test_breakdown_raises_like_scipy(self):
+        rng = np.random.default_rng(145)
+        ab, _ = _spd_band(rng, 16, 2)
+        ab[0, 5] = -100.0  # indefinite diagonal entry
+        with pytest.raises(ValueError, match="positive definite"):
+            banded.solveh_banded(jnp.asarray(ab), jnp.ones(16), lower=True)
+
+    def test_rides_the_partitioned_driver(self):
+        # the point of the adapter: a banded solve dispatching to the
+        # Spike path, bitwise-compared against scipy
+        rng = np.random.default_rng(146)
+        n, u = 64, 3
+        ab, _ = _spd_band(rng, n, u)
+        rhs = rng.standard_normal((n, 2))
+        x = banded.solveh_banded(
+            jnp.asarray(ab), jnp.asarray(rhs), lower=True,
+            impl="partitioned", partitions=2, partition_inner="xla")
+        ref = scipy_linalg.solveh_banded(ab, rhs, lower=True)
+        np.testing.assert_allclose(np.asarray(x), ref, rtol=0, atol=1e-10)

@@ -1,0 +1,416 @@
+"""Partitioned (Spike) blocktri driver tests (ISSUE 13 acceptance).
+
+The properties pinned here, mapped to the issue's criteria:
+
+* partitioned posv matches the sequential scan AND the dense reference
+  across (nblocks, b, P) ladders, xla-inner f64 and pallas-inner f32 —
+  including the m − 1 = 1 edge where both spike columns land in the same
+  interior block (TestParity);
+* partition-relative breakdown pivots map to EXACT whole-chain indices:
+  a negative diagonal in an interior block and in a separator both
+  reproduce the sequential impl's global info bit-for-bit, and NaN
+  pollution stays contained to its batch element (TestInfoMapping — the
+  `_combine_partitioned` regression);
+* dispatch policy: resolve_partitions divisor snapping / √nblocks
+  default / degenerate fallbacks, auto flips to partitioned only past
+  PARTITION_MIN_NBLOCKS (f64 auto keeps the scan; forcing is legal —
+  exact-dtype inner), factor/solve/extend reject the posv-only impl
+  (TestDispatch);
+* the jaxpr sequential-depth counter prices the win the bench gates:
+  3·nblocks trips sequential vs 3·(m−1) + 3·P partitioned
+  (TestDepthCounter, the obs/xla_audit seam);
+* serve: blocktri_impl/blocktri_partitions join the cfg-hash (engines
+  differing there never share AOT entries), a partitioned engine solves
+  to parity with zero steady-state recompiles, the impl split lands in
+  request_stats / merge_snapshots / serve-report and validates under
+  obs.ledger (TestServePartitioned);
+* bench ledger: partitions/depth/depth_seq/depth_reduction fields
+  validate, malformed ones are LedgerIncompatible (TestLedgerFields);
+* the autotune partitions × block-unroll axis measures deduped snapped
+  configs and checkpoint-resumes without re-measuring (TestAutotune).
+
+Same rig posture as test_blocktri: conftest CPU, x64 on, f64 resolves
+to the xla scans, pallas-inner runs the interpreted kernels.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from capital_tpu.models import blocktri
+from capital_tpu.obs import ledger, xla_audit
+from capital_tpu.obs import __main__ as obs_main
+from capital_tpu.serve import ServeConfig, SolveEngine, stats
+
+from tests.test_blocktri import _chain, _dense_solve
+
+
+def _posv_pair(D, C, B, *, partitions=0, inner="auto", dtype=None,
+               **kw):
+    """(partitioned, sequential) solves of the same chain — the A/B the
+    parity ladder compares.  The sequential side forces the exact-dtype
+    scan so f64 references stay f64."""
+    dt = dtype or jnp.float64
+    Dj, Cj, Bj = (jnp.asarray(x, dt) for x in (D, C, B))
+    Xp, ip = blocktri.posv(Dj, Cj, Bj, impl="partitioned",
+                           partitions=partitions, partition_inner=inner,
+                           **kw)
+    Xs, is_ = blocktri.posv(Dj, Cj, Bj, impl="xla", **kw)
+    return (np.asarray(Xp), np.asarray(ip)), (np.asarray(Xs),
+                                              np.asarray(is_))
+
+
+class TestParity:
+    @pytest.mark.parametrize("nblocks,b,P", [
+        (8, 4, 2),   # m - 1 = 3 interior blocks
+        (8, 4, 4),   # m - 1 = 1: both spikes in ONE interior block
+        pytest.param(16, 4, 4, marks=pytest.mark.slow),
+        pytest.param(12, 8, 3, marks=pytest.mark.slow),
+        (16, 4, 0),  # default resolve: P = 4
+    ])
+    def test_partitioned_matches_scan_and_dense_f64(self, nblocks, b, P):
+        rng = np.random.default_rng(130)
+        D, C, B = _chain(rng, 2, nblocks, b, 3)
+        (Xp, ip), (Xs, is_) = _posv_pair(D, C, B, partitions=P)
+        np.testing.assert_array_equal(ip, 0)
+        np.testing.assert_array_equal(is_, 0)
+        np.testing.assert_allclose(Xp, Xs, rtol=0, atol=1e-11)
+        np.testing.assert_allclose(Xp, _dense_solve(D, C, B),
+                                   rtol=0, atol=1e-11)
+
+    def test_pallas_inner_matches_dense_f32(self):
+        rng = np.random.default_rng(131)
+        D, C, B = _chain(rng, 2, 16, 8, 2)
+        X, info = blocktri.posv(
+            jnp.asarray(D, jnp.float32), jnp.asarray(C, jnp.float32),
+            jnp.asarray(B, jnp.float32), impl="partitioned",
+            partitions=4, partition_inner="pallas")
+        ref = _dense_solve(D, C, B)
+        np.testing.assert_array_equal(np.asarray(info), 0)
+        err = np.abs(np.float64(np.asarray(X)) - ref).max()
+        assert err < 5e-5 * np.abs(ref).max()
+
+    def test_auto_dispatch_is_partitioned_above_threshold(self):
+        # auto picks the partitioned driver at the flagship length and the
+        # result still matches dense — the PR 6 "auto picks the winner"
+        # contract on the new algorithm
+        assert blocktri.posv_algorithm(64, jnp.float32) == "partitioned"
+        rng = np.random.default_rng(132)
+        D, C, B = _chain(rng, 1, 16, 4, 1)
+        X, info = blocktri.posv(
+            jnp.asarray(D, jnp.float32), jnp.asarray(C, jnp.float32),
+            jnp.asarray(B, jnp.float32))
+        np.testing.assert_array_equal(np.asarray(info), 0)
+        ref = _dense_solve(D, C, B)
+        err = np.abs(np.float64(np.asarray(X)) - ref).max()
+        assert err < 5e-5 * np.abs(ref).max()
+
+
+class TestInfoMapping:
+    def _spiked_identity(self, nblocks, b, batch=2):
+        """Identity chain (zero couplings) — breakdown location is then
+        exactly where the poison sits, for both algorithms."""
+        D = np.broadcast_to(np.eye(b), (batch, nblocks, b, b)).copy()
+        C = np.zeros((batch, nblocks, b, b))
+        B = np.ones((batch, nblocks, b, 1))
+        return D, C, B
+
+    @pytest.mark.parametrize("g,r", [
+        pytest.param(2, 1, marks=pytest.mark.slow), (3, 0), (5, 2)])
+    def test_interior_pivot_maps_to_global_index(self, g, r):
+        # P=2, m=4: separators at blocks 3 and 7; g ∈ {2, 5} interior,
+        # g = 3 separator — all must report a pivot INSIDE the poisoned
+        # block at the whole-chain offset (the CPU LAPACK path NaN-fills
+        # the whole failed block, so the local row is backend-defined;
+        # the partition-relative → global mapping is what we pin) and be
+        # bit-identical to the sequential impl's answer
+        nblocks, b = 8, 4
+        D, C, B = self._spiked_identity(nblocks, b)
+        D[0, g, r, r] = -1.0
+        (Xp, ip), (Xs, is_) = _posv_pair(D, C, B, partitions=2)
+        assert ip[0] == is_[0]
+        assert g * b < ip[0] <= (g + 1) * b
+        assert ip[1] == is_[1] == 0
+
+    def test_nan_contained_to_batch_element(self):
+        nblocks, b, g = 8, 4, 4
+        D, C, B = self._spiked_identity(nblocks, b)
+        D[1, g, 0, 0] = np.nan
+        (Xp, ip), (Xs, is_) = _posv_pair(D, C, B, partitions=2)
+        assert ip[0] == 0 and is_[0] == 0
+        assert ip[1] == is_[1] != 0
+        # pollution flows forward: the reported first-broken index can
+        # never precede the poisoned block
+        assert ip[1] >= g * b + 1
+        # the healthy element's solution is untouched by its neighbor
+        np.testing.assert_allclose(Xp[0], Xs[0], rtol=0, atol=1e-12)
+
+
+class TestDispatch:
+    def test_resolve_partitions_policy(self):
+        assert blocktri.resolve_partitions(64) == 8      # √64
+        assert blocktri.resolve_partitions(16) == 4
+        assert blocktri.resolve_partitions(64, 16) == 16
+        assert blocktri.resolve_partitions(64, 5) == 4   # snap down
+        assert blocktri.resolve_partitions(64, 63) == 32  # cap nblocks/2
+        assert blocktri.resolve_partitions(7) == 1       # prime
+        assert blocktri.resolve_partitions(2) == 1       # m >= 2 floor
+        assert blocktri.resolve_partitions(4, 2) == 2
+
+    def test_auto_policy(self):
+        f32, f64 = jnp.float32, jnp.float64
+        assert blocktri.posv_algorithm(64, f32) == "partitioned"
+        assert blocktri.posv_algorithm(8, f32) == "scan"
+        # explicit partitions opt in below the threshold
+        assert blocktri.posv_algorithm(8, f32, partitions=2) == "partitioned"
+        # f64 auto keeps the sequential scan; forcing is the explicit
+        # opt-in (exact-dtype inner, no downgrade)
+        assert blocktri.posv_algorithm(64, f64) == "scan"
+        assert blocktri.posv_algorithm(
+            64, f64, impl="partitioned") == "partitioned"
+        # unsplittable chains resolve to scan even when forced
+        assert blocktri.posv_algorithm(7, f32, impl="partitioned") == "scan"
+
+    def test_forced_partitioned_f64_is_exact(self):
+        rng = np.random.default_rng(133)
+        D, C, B = _chain(rng, 1, 8, 4, 1)
+        X, info = blocktri.posv(
+            jnp.asarray(D), jnp.asarray(C), jnp.asarray(B),
+            impl="partitioned", partitions=2)
+        assert X.dtype == jnp.float64
+        np.testing.assert_array_equal(np.asarray(info), 0)
+        np.testing.assert_allclose(np.asarray(X), _dense_solve(D, C, B),
+                                   rtol=0, atol=1e-11)
+
+    def test_unsplittable_falls_back_to_scan(self):
+        rng = np.random.default_rng(134)
+        D, C, B = _chain(rng, 1, 3, 4, 1)  # prime: no valid split
+        X, info = blocktri.posv(
+            jnp.asarray(D), jnp.asarray(C), jnp.asarray(B),
+            impl="partitioned")
+        np.testing.assert_array_equal(np.asarray(info), 0)
+        np.testing.assert_allclose(np.asarray(X), _dense_solve(D, C, B),
+                                   rtol=0, atol=1e-11)
+
+    def test_factor_solve_extend_reject_partitioned(self):
+        rng = np.random.default_rng(135)
+        D, C, B = _chain(rng, 1, 4, 4, 1)
+        Dj, Cj, Bj = (jnp.asarray(x) for x in (D, C, B))
+        with pytest.raises(ValueError, match="posv-only"):
+            blocktri.factor(Dj, Cj, impl="partitioned")
+        L, Wt, _ = blocktri.factor(Dj, Cj)
+        with pytest.raises(ValueError, match="posv-only"):
+            blocktri.solve(L, Wt, Bj, impl="partitioned")
+        with pytest.raises(ValueError, match="posv-only"):
+            blocktri.extend(Dj, Cj, L[:, -1], impl="partitioned")
+
+    def test_bad_partition_inner_rejected(self):
+        rng = np.random.default_rng(136)
+        D, C, B = _chain(rng, 1, 8, 4, 1)
+        with pytest.raises(ValueError, match="partition_inner"):
+            blocktri.posv(jnp.asarray(D), jnp.asarray(C), jnp.asarray(B),
+                          impl="partitioned", partition_inner="cuda")
+
+
+class TestDepthCounter:
+    def test_scan_depth_counts_trip_lengths(self):
+        def body(c, x):
+            return c + x, c
+
+        def fn(xs):
+            return jax.lax.scan(body, jnp.zeros(()), xs)
+
+        assert xla_audit.sequential_depth(fn, jnp.ones(5)) == 5
+
+    def test_posv_depth_sequential_vs_partitioned(self):
+        rng = np.random.default_rng(137)
+        nblocks, b = 16, 4
+        D, C, B = _chain(rng, 1, nblocks, b, 1)
+        Dj, Cj, Bj = (jnp.asarray(x) for x in (D, C, B))
+        d_seq = xla_audit.sequential_depth(
+            lambda d, c, r: blocktri.posv(d, c, r, impl="xla"),
+            Dj, Cj, Bj)
+        d_par = xla_audit.sequential_depth(
+            lambda d, c, r: blocktri.posv(
+                d, c, r, impl="partitioned", partitions=4,
+                partition_inner="xla"),
+            Dj, Cj, Bj)
+        # 3 scans × nblocks trips vs 3 × (m − 1) interior + 3 × P reduced
+        assert d_seq == 3 * nblocks
+        assert d_par == 3 * (nblocks // 4 - 1) + 3 * 4
+        assert d_seq / d_par > 2
+
+
+BT_PAR_CFG = ServeConfig(
+    buckets=(8,),
+    rows_buckets=(32,),
+    nrhs_buckets=(1,),
+    max_batch=2,
+    max_delay_s=10.0,
+    nblocks_buckets=(4,),
+    block_buckets=(4,),
+    blocktri_impl="partitioned",
+    blocktri_partitions=2,
+)
+
+
+class TestServePartitioned:
+    def test_cfg_validation(self):
+        # the engine is the validation seam (ServeConfig is a frozen
+        # plain dataclass, like the bucket fields)
+        with pytest.raises(ValueError, match="blocktri_impl"):
+            SolveEngine(cfg=ServeConfig(blocktri_impl="spike"))
+        with pytest.raises(ValueError, match="blocktri_partitions"):
+            SolveEngine(cfg=ServeConfig(blocktri_partitions=-1))
+
+    def test_blocktri_knobs_join_config_hash(self):
+        base = dict(
+            buckets=BT_PAR_CFG.buckets,
+            rows_buckets=BT_PAR_CFG.rows_buckets,
+            nrhs_buckets=BT_PAR_CFG.nrhs_buckets,
+            max_batch=BT_PAR_CFG.max_batch,
+            max_delay_s=BT_PAR_CFG.max_delay_s,
+            nblocks_buckets=BT_PAR_CFG.nblocks_buckets,
+            block_buckets=BT_PAR_CFG.block_buckets,
+        )
+        hashes = {
+            SolveEngine(cfg=ServeConfig(**base, **kw))._cfg_hash
+            for kw in (
+                {},
+                {"blocktri_impl": "partitioned"},
+                {"blocktri_impl": "partitioned", "blocktri_partitions": 2},
+                {"blocktri_impl": "scan"},
+            )
+        }
+        assert len(hashes) == 4  # no pair may ever share an AOT entry
+
+    def test_partitioned_engine_parity_and_stats(self):
+        rng = np.random.default_rng(138)
+        eng = SolveEngine(cfg=BT_PAR_CFG)
+        for seed in range(2):
+            D, C, B = _chain(rng, 1, 4, 4, 1)
+            r = eng.solve("posv_blocktri", np.stack([D[0], C[0]]), B[0])
+            assert r.ok and r.batched
+            np.testing.assert_allclose(
+                np.asarray(r.x, np.float64), _dense_solve(D, C, B)[0],
+                rtol=0, atol=1e-4)
+        c = eng.cache_stats()
+        assert (c["hits"], c["misses"]) == (1, 1)  # zero steady-state
+        assert eng.stats.blocktri_impls == {"partitioned": 2}
+        snap = eng.stats.snapshot(cache=c)
+        assert snap["blocktri_impls"] == {"partitioned": 2}
+        assert ledger.validate_request_stats(snap) == []
+
+    def test_scan_engine_notes_scan(self):
+        rng = np.random.default_rng(139)
+        cfg_scan = ServeConfig(
+            buckets=(8,), rows_buckets=(32,), nrhs_buckets=(1,),
+            max_batch=2, max_delay_s=10.0, nblocks_buckets=(4,),
+            block_buckets=(4,), blocktri_impl="scan")
+        eng = SolveEngine(cfg=cfg_scan)
+        D, C, B = _chain(rng, 1, 4, 4, 1)
+        assert eng.solve("posv_blocktri", np.stack([D[0], C[0]]),
+                         B[0]).ok
+        assert eng.stats.blocktri_impls == {"scan": 1}
+
+    def test_merge_snapshots_pools_the_split(self):
+        def snap(n_scan, n_par, replica):
+            c = stats.Collector(replica_id=replica)
+            for _ in range(n_scan):
+                c.note_blocktri_impl("scan")
+            for _ in range(n_par):
+                c.note_blocktri_impl("partitioned")
+            c.record_request("posv_blocktri", 0.01, ok=True)
+            return c.snapshot()
+
+        merged = stats.merge_snapshots([snap(2, 1, "r0"), snap(0, 3, "r1")])
+        assert merged["blocktri_impls"] == {"scan": 2, "partitioned": 4}
+        assert ledger.validate_request_stats(merged) == []
+
+    def test_malformed_split_is_flagged(self):
+        c = stats.Collector()
+        c.note_blocktri_impl("partitioned")
+        c.record_request("posv_blocktri", 0.01, ok=True)
+        snap = c.snapshot()
+        snap["blocktri_impls"] = {"cuda": 1}
+        assert any("blocktri_impls" in p
+                   for p in ledger.validate_request_stats(snap))
+        snap["blocktri_impls"] = {"scan": -1}
+        assert any("blocktri_impls" in p
+                   for p in ledger.validate_request_stats(snap))
+
+    def test_serve_report_prints_impl_split(self, tmp_path, capsys):
+        c = stats.Collector()
+        c.record_request("posv_blocktri", 0.01, ok=True)
+        c.note_blocktri_impl("partitioned")
+        c.note_blocktri_impl("scan")
+        path = tmp_path / "serve.jsonl"
+        c.emit(str(path), cache={"hits": 1, "misses": 1,
+                                 "warmup_compiles": 1, "entries": 1,
+                                 "hit_rate": 0.5})
+        assert obs_main.main(["serve-report", str(path)]) == 0
+        out = capsys.readouterr().out
+        assert "blocktri partitioned=1 scan=1" in out
+
+
+def _par_measured(**over):
+    m = {"metric": "blocktri_tflops", "value": 1.5, "nblocks": 64,
+         "block": 8, "n": 512, "batch": 2, "nrhs": 2,
+         "impl": "partitioned", "speedup": 6.0, "partitions": 8,
+         "depth": 45, "depth_seq": 192, "depth_reduction": 4.267}
+    m.update(over)
+    return m
+
+
+class TestLedgerFields:
+    def test_partitioned_record_passes_diff(self):
+        rec = ledger.record("bench:blocktri", ledger.manifest(),
+                            measured=_par_measured())
+        assert ledger.diff([rec], [rec]) == []
+
+    @pytest.mark.parametrize("field,bad", [
+        ("partitions", 0), ("partitions", "8"), ("depth", -1),
+        ("depth_seq", 1.5), ("depth_reduction", 0),
+    ])
+    def test_malformed_fields_flagged(self, field, bad):
+        probs = ledger.validate_blocktri_measured(_par_measured(**{field: bad}))
+        assert any(field in p for p in probs)
+
+    def test_malformed_record_is_incompatible(self):
+        rec = ledger.record("bench:blocktri", ledger.manifest(),
+                            measured=_par_measured(depth=0))
+        with pytest.raises(ledger.LedgerIncompatible, match="depth"):
+            ledger.diff([rec], [rec])
+
+
+class TestAutotune:
+    def test_partitions_axis_dedupes_and_resumes(self, tmp_path,
+                                                 monkeypatch, capsys):
+        from capital_tpu.autotune import sweep
+        from capital_tpu.bench import harness
+        from capital_tpu.parallel.topology import Grid
+
+        grid = Grid.square(c=1, devices=jax.devices("cpu")[:1])
+        kw = dict(batch=2, nrhs=1, dtype=jnp.float32,
+                  out_dir=str(tmp_path), calls=2, warmup=1,
+                  checkpoint=True, impls=("partitioned",),
+                  partitions=(0, 2, 4), blocks=(0,))
+        res1 = sweep.tune_blocktri(grid, 8, 4, **kw)
+        # resolve_partitions(8, 0) == 2: the 0 and 2 requests collapse to
+        # ONE measured config; 4 stays distinct
+        ids = sorted(r.config_id for r in res1)
+        assert ids == ["part_p2_b4", "part_p4_b4"]
+
+        calls = []
+        real = harness.latency_samples
+
+        def counting(*a, **k):
+            calls.append(1)
+            return real(*a, **k)
+
+        monkeypatch.setattr(harness, "latency_samples", counting)
+        res2 = sweep.tune_blocktri(grid, 8, 4, **kw)
+        assert not calls  # everything resumed, nothing re-measured
+        assert [r.config_id for r in res2] == [r.config_id for r in res1]
+        assert [r.seconds for r in res2] == [r.seconds for r in res1]

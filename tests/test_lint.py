@@ -530,7 +530,7 @@ class TestEngineValidate:
     def test_dropped_donation_raises_at_insert(self, monkeypatch):
         # force the hazard: a posv whose "solution" cannot alias the donated
         # RHS batch — validate must refuse the cache insert
-        def bad_batched(op, precision, impl="auto"):
+        def bad_batched(op, precision, impl="auto", **kw):
             def fn(Ab, Bb):
                 return jnp.sum(Bb, axis=2), jnp.zeros(
                     Ab.shape[0], jnp.int32)
@@ -544,7 +544,7 @@ class TestEngineValidate:
                 eng.warmup([("posv", (8, 8), (8, 1), "float64")])
 
     def test_validate_off_keeps_seed_behavior(self, monkeypatch):
-        def bad_batched(op, precision, impl="auto"):
+        def bad_batched(op, precision, impl="auto", **kw):
             def fn(Ab, Bb):
                 return jnp.sum(Bb, axis=2), jnp.zeros(
                     Ab.shape[0], jnp.int32)

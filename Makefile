@@ -6,8 +6,8 @@
 PY ?= python
 
 .PHONY: all test benchmarking bench-explicit bench-small bench-blocktri \
-	bench-blocktri-par bench-update tune audit lint robust serve-smoke \
-	serve-bench serve-replicas native clean
+	bench-blocktri-par bench-update bench-refine tune audit lint robust \
+	serve-smoke serve-bench serve-replicas native clean
 
 all: test
 
@@ -105,6 +105,29 @@ bench-update:
 # LIFETIME counters, so the engine's per-bucket warmup lookups dilute the
 # steady-state 0.92 the driver gates on delta counters)
 
+# mixed-precision iterative-refinement gate (docs/PERF.md round 14): the
+# guaranteed-tier posv program (f32 factor + f64 Wilkinson sweeps) vs the
+# straight f64 factor on cond ~1e5 masters.  The speedup gate is on the
+# FACTOR PHASE (f32 vs f64 potrf, >= 1.5x — measured ~1.9x, this rig's
+# whole f32:f64 LAPACK gap); end-to-end latency rides the record ungated
+# because on CPU the sweeps price in at XLA's ~2.4 GFLOP/s skinny-RHS
+# potrs and land the ratio below 1 — docs/PERF.md round 14 owns that
+# honesty note.  The accuracy half IS gated: refined backward error
+# <= 10x the straight f64 factor's (measured ~0.9-1.8x) and <= the
+# absolute f64 tolerance, all problems converged, plus the cond-1e12
+# TSQR escalation probe (ortho <= 1e-13) and the mixed-tier serve smoke
+# at zero steady-state recompiles.  obs serve-report then re-gates the
+# smoke's request_stats record: sweep cap and converged fraction from
+# the refine block (fails loudly if no record carries one).
+bench-refine:
+	rm -f bench_refine.jsonl
+	$(PY) -m capital_tpu.bench refine --platform cpu --n 1024 --nrhs 4 \
+		--batch 4 --dtype float64 --iters 3 --validate \
+		--min-speedup 1.5 --max-resid-ratio 10 \
+		--ledger bench_refine.jsonl
+	$(PY) -m capital_tpu.obs serve-report bench_refine.jsonl \
+		--max-refine-iters 6 --min-converged-frac 0.99
+
 # model-vs-compiled drift gate on the flagship configs (docs/OBSERVABILITY.md);
 # compile-only — runs in CI without a TPU (exit non-zero on drift).  The
 # bench.trace step is the phase-attribution gate: it decomposes a real
@@ -115,7 +138,7 @@ bench-update:
 # The generous 0.995 bound absorbs CPU-interpret emulation; what it pins
 # is that attribution works end to end.
 audit: serve-smoke serve-bench serve-replicas bench-blocktri \
-	bench-blocktri-par bench-update lint
+	bench-blocktri-par bench-update bench-refine lint
 	$(PY) -m capital_tpu.obs audit cholinv --n 4096 --platform cpu
 	$(PY) -m capital_tpu.obs audit cacqr --m 16384 --n 512 --platform cpu
 	$(PY) -m capital_tpu.obs robust-gate --platform cpu
@@ -215,5 +238,5 @@ clean:
 	rm -rf autotune_out .pytest_cache bench_explicit.jsonl serve_smoke.jsonl \
 		lint_report.jsonl bench_small.jsonl serve_bench.jsonl serve_cache \
 		bench_trace.jsonl serve_replicas.jsonl serve_replicas_cache \
-		bench_blocktri.jsonl bench_update.jsonl
+		bench_blocktri.jsonl bench_update.jsonl bench_refine.jsonl
 	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -22,7 +22,7 @@ from capital_tpu.lint.program import ProgramTarget
 
 TARGET_NAMES = ("cholinv", "cacqr", "serve", "batched_small", "serve_sched",
                 "cholinv_fused", "blocktri", "blocktri_partitioned",
-                "update_small")
+                "update_small", "refine")
 
 
 def _grid():
@@ -236,6 +236,44 @@ def update_small_target(
     )
 
 
+def refine_target(
+    n: int = 64, nrhs: int = 4, capacity: int = 4, dtype=jnp.bfloat16,
+) -> ProgramTarget:
+    """The accuracy_tier='guaranteed' bucket program (robust/refine through
+    api.batched — the 5-output executable serve/engine compiles for tiered
+    posv traffic): low-dtype factor + upgraded-dtype correction sweeps
+    under ``IR::residual`` / ``IR::correct`` — both phase tags under the
+    phase-coverage rule.
+
+    bf16 inputs on purpose: the guaranteed plan for bf16 factors in bf16
+    and corrects in f32, so the WHOLE mixed-precision ladder stays below
+    f64 — a program whose jaxpr emits zero float64 equations, which is
+    exactly what rule_dtype_drift then proves (the rule exempts programs
+    with wide INPUTS, so a narrow-input tier program is the only shape
+    that makes the no-f64-leak claim checkable).  ``flops_audited=False``:
+    the refinement loop's sweep count is data-dependent (lax.while_loop),
+    while the phase registry prices exactly one sweep — the whole-program
+    flops envelope would flag the design, not a bug (measured sweep counts
+    live in serve stats' refine block instead).  No donation — the tiered
+    program keeps both operands live across every sweep's residual."""
+    from capital_tpu.serve import api
+
+    dt = jnp.dtype(dtype)
+    a_sds = jax.ShapeDtypeStruct((capacity, n, n), dt)
+    b_sds = jax.ShapeDtypeStruct((capacity, n, nrhs), dt)
+
+    solve = api.batched("posv", tier="guaranteed")
+
+    def step(a, b):
+        X, iters, converged, resid, info = solve(a, b)
+        return X, iters, converged, resid, info
+
+    return ProgramTarget(
+        name=f"refine-posv-b{capacity}-n{n}", fn=step,
+        args=(a_sds, b_sds), flops_audited=False,
+    )
+
+
 def cholinv_fused_target(n: int = 512, dtype=jnp.float32) -> ProgramTarget:
     """The fused-recursion-tail cholinv program (CholinvConfig.
     tail_fuse_depth > 0): n=512 with bc=128 and depth 2 fuses the whole
@@ -328,6 +366,8 @@ def flagship_targets(names=None) -> list[ProgramTarget]:
             out.append(blocktri_partitioned_target())
         elif name == "update_small":
             out.append(update_small_target())
+        elif name == "refine":
+            out.append(refine_target())
         else:
             raise ValueError(
                 f"unknown lint target {name!r}; expected one of {TARGET_NAMES}"

@@ -56,6 +56,7 @@ from capital_tpu.parallel import summa
 from capital_tpu.parallel.summa import GemmArgs, SyrkArgs, TrmmArgs
 from capital_tpu.parallel.topology import Grid
 from capital_tpu.robust import faultinject, recovery
+from capital_tpu.robust import config as config_mod
 from capital_tpu.robust.config import RobustConfig, RobustInfo
 from capital_tpu.utils import jax_compat, tracing
 
@@ -711,12 +712,16 @@ def _factor_core(
     return Q, R
 
 
-def _finish_robust(grid: Grid, Q, R, cfg: CacqrConfig, ses: _Session):
+def _finish_robust(grid: Grid, A, Q, R, cfg: CacqrConfig, ses: _Session):
     """Aggregate the session's CholEvents into a RobustInfo and, on
-    breakdown, run the sCQR3 escalation: one more (muted) gram + guarded
-    chol + scale, entered only when the orthogonality gate of the recovered
-    Q still exceeds tolerance.  Everything is lax.cond-gated, so the
-    healthy path executes only the O(n²) status reductions."""
+    breakdown, run the escalation ladder: the sCQR3 third sweep (one more
+    muted gram + guarded chol + scale) when the orthogonality gate of the
+    recovered Q still exceeds tolerance, then — under rcfg.tsqr — the
+    blocked Householder TSQR rung (ops/tsqr at the always-f64 escalation
+    dtype) when even sCQR3 leaves the gate failing.  Everything is
+    lax.cond-gated, so the healthy path executes only the O(n²) status
+    reductions.  RobustInfo.gate records WHICH gate a surviving nonzero
+    info came from (GATE_ORTHO vs GATE_RESIDUAL, robust/config.py)."""
     rcfg = ses.rcfg
     m, n = Q.shape[0], R.shape[0]
     if ses.events:
@@ -738,6 +743,8 @@ def _finish_robust(grid: Grid, Q, R, cfg: CacqrConfig, ses: _Session):
         info = jnp.int32(0)
     escalated = jnp.int32(0)
     ortho = jnp.float32(-1.0)
+    ortho_failed = jnp.bool_(False)
+    info3 = jnp.int32(0)
     if rcfg.escalate and ses.events:
         tol = rcfg.ortho_tol
         if tol is None:
@@ -801,20 +808,61 @@ def _finish_robust(grid: Grid, Q, R, cfg: CacqrConfig, ses: _Session):
         Q, R, escalated, ortho, info3 = lax.cond(
             breakdown > 0, _broke, _fine, (Q, R)
         )
-        # the sentinel n+2: every chol after recovery was clean, yet the
-        # final orthogonality gate still fails — cond(A) is beyond what
+        # the sentinel condition: every chol after recovery was clean, yet
+        # the final orthogonality gate still fails — cond(A) is beyond what
         # sCQR3 can repair at this precision (per shifted sweep cond drops
         # only by ~sqrt(shift_c*u*(m*n+n(n+1))); in f32 that's a factor of
-        # a few — see docs/ROBUSTNESS.md).  The result is finite but NOT
-        # orthogonal to tolerance, and info says so.
+        # a few — see docs/ROBUSTNESS.md).
         unrecovered = (escalated > 0) & (ortho > tol)
+        if rcfg.tsqr:
+            # the rung above sCQR3: re-factor A itself with the blocked
+            # Householder TSQR at the escalation dtype (always-f64 rule,
+            # recovery.escalation_dtype) — no gram, so cond(A) up to ~u⁻¹
+            # recovers where every CQR-family sweep stalls.  Gated on the
+            # same traced predicate; muted like the other recovery work.
+            ct = recovery.escalation_dtype(Q.dtype)
+            tol_e = 100.0 * n * recovery.unit_roundoff(ct)
+
+            def _tsqr_rung(args):
+                Q1, R1 = args
+                with tracing.scope("CQR::recover"), tracing.muted():
+                    from capital_tpu.ops import tsqr as tsqr_mod
+
+                    Qt, Rt = tsqr_mod.tsqr(
+                        A.astype(ct), precision=cfg.precision
+                    )
+                    gate_t = tsqr_mod.ortho_gate(Qt, cfg.precision)
+                    return Qt.astype(Q1.dtype), Rt.astype(R1.dtype), gate_t
+
+            def _keep_qr(args):
+                Q1, R1 = args
+                return Q1, R1, ortho
+
+            Q, R, ortho = lax.cond(unrecovered, _tsqr_rung, _keep_qr, (Q, R))
+            escalated = jnp.where(unrecovered, jnp.int32(2), escalated)
+            # recovered iff the f64-measured gate now passes the f64 tol —
+            # the sentinel (and gate code) below read the updated verdict
+            unrecovered = unrecovered & (ortho > tol_e)
+        ortho_failed = unrecovered
         info = jnp.maximum(
             jnp.maximum(info, info3),
             jnp.where(unrecovered, jnp.int32(n + 2), jnp.int32(0)),
         )
+    # which gate does a nonzero info describe?  The ortho-gate sentinel
+    # outranks residual statuses (it is the TSQR-escalatable case the
+    # routing exists to distinguish — robust/config.GATE_* vocabulary).
+    gate_code = jnp.where(
+        ortho_failed,
+        jnp.int32(config_mod.GATE_ORTHO),
+        jnp.where(
+            jnp.maximum(info, info3) > 0,
+            jnp.int32(config_mod.GATE_RESIDUAL),
+            jnp.int32(config_mod.GATE_NONE),
+        ),
+    )
     return Q, R, RobustInfo(
         info=info, breakdown=breakdown, shifted=shifted, sigma=sigma,
-        escalated=escalated, ortho=ortho,
+        escalated=escalated, ortho=ortho, gate=gate_code,
     )
 
 
@@ -847,7 +895,7 @@ def factor(grid: Grid, A: jnp.ndarray, cfg: CacqrConfig = CacqrConfig()):
         Q, R = _factor_core(grid, A, cfg, regime)
     finally:
         _ROBUST.pop()
-    return _finish_robust(grid, Q, R, cfg, ses)
+    return _finish_robust(grid, A, Q, R, cfg, ses)
 
 
 def apply_Q(

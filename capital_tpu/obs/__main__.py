@@ -216,6 +216,8 @@ def _serve_report(args) -> int:
                 or args.min_occupancy is not None
                 or args.max_queue_wait_ms is not None
                 or args.min_residency_hit_rate is not None
+                or args.max_refine_iters is not None
+                or args.min_converged_frac is not None
                 or args.min_replicas is not None
                 or args.aggregate)
     if not rows:
@@ -226,6 +228,7 @@ def _serve_report(args) -> int:
     small_seen = 0
     split_seen = 0
     factor_seen = 0
+    refine_seen = 0
     for i, r in enumerate(rows):
         rs = r["request_stats"]
         man = r.get("manifest") or {}
@@ -262,6 +265,15 @@ def _serve_report(args) -> int:
             " blocktri " + " ".join(f"{k}={bti[k]}" for k in sorted(bti))
             if bti else ""
         )
+        # guaranteed-tier refinement telemetry (Collector.note_refine);
+        # absent without accuracy_tier='guaranteed' traffic
+        rf = rs.get("refine")
+        rf_note = (
+            f" refine requests={rf['requests']} "
+            f"converged_frac={rf['converged_frac']} "
+            f"iters_max={rf['iters_max']} resid_max={rf['resid_max']:.2e}"
+            if rf else ""
+        )
         print(
             f"# [{i}] {man.get('platform', '?')}/{man.get('device', '?')} "
             f"requests={rs['requests']} ok={rs['ok']} "
@@ -271,7 +283,8 @@ def _serve_report(args) -> int:
             f"queue_max={rs['queue_depth_max']} "
             f"cache hits={cache['hits']} misses={cache['misses']} "
             f"hit_rate={cache['hit_rate']:.3f}"
-            + small_note + split_note + ops_note + bti_note + fc_note
+            + small_note + split_note + ops_note + bti_note + rf_note
+            + fc_note
         )
         if (args.min_hit_rate is not None
                 and cache["hit_rate"] < args.min_hit_rate):
@@ -309,6 +322,25 @@ def _serve_report(args) -> int:
                     "(tokens evicted under the byte budget, or clients "
                     "updating factors that were never seeded — see "
                     "docs/SERVING.md 'Factor residency')"
+                )
+        if rf is not None:
+            refine_seen += 1
+            if (args.max_refine_iters is not None
+                    and rf["iters_max"] > args.max_refine_iters):
+                failures.append(
+                    f"record #{i}: refine iters_max {rf['iters_max']} > "
+                    f"{args.max_refine_iters} (guaranteed-tier requests "
+                    "burning more correction sweeps than the latency "
+                    "budget planned for — operands more ill-conditioned "
+                    "than the tier's factor dtype expects?)"
+                )
+            if (args.min_converged_frac is not None
+                    and rf["converged_frac"] < args.min_converged_frac):
+                failures.append(
+                    f"record #{i}: refine converged_frac "
+                    f"{rf['converged_frac']} < {args.min_converged_frac} "
+                    "(guaranteed-tier requests failing loudly instead of "
+                    "converging — see docs/SERVING.md 'Accuracy tiers')"
                 )
         if qwait is not None:
             split_seen += 1
@@ -392,6 +424,13 @@ def _serve_report(args) -> int:
             "--max-queue-wait-ms requested but no record carries a "
             "queue_wait_ms block (records predate the latency split, or "
             "nothing dispatched?)"
+        )
+    if (args.max_refine_iters is not None
+            or args.min_converged_frac is not None) and not refine_seen:
+        failures.append(
+            "--max-refine-iters/--min-converged-frac requested but no "
+            "record carries a refine block (no accuracy_tier='guaranteed' "
+            "traffic served?)"
         )
     for f in failures:
         print(f"serve-report gate FAIL: {f}", file=sys.stderr)
@@ -597,6 +636,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="fail when any record's factor_cache.hit_rate "
                    "(serve/factorcache.py residency counters) is below "
                    "this; fails loudly when NO record carries the block")
+    s.add_argument("--max-refine-iters", type=int, default=None,
+                   help="gate: fail when any record's refine.iters_max "
+                        "(guaranteed-tier correction sweeps, "
+                        "Collector.note_refine) exceeds this; fails loudly "
+                        "when NO record carries the refine block")
+    s.add_argument("--min-converged-frac", type=float, default=None,
+                   help="gate: fail when any record's refine.converged_frac "
+                        "is below this; fails loudly when NO record "
+                        "carries the refine block")
     s.add_argument("--max-p99-ms-small", type=float, default=None,
                    help="gate the small-N bucket latency split separately: "
                         "fail when any record's latency_ms_small.p99 "

@@ -376,6 +376,43 @@ def validate_request_stats(block) -> list[str]:
                     f"factor_cache.hit_rate {hr!r} inconsistent with "
                     f"hits={h} misses={m} (expected {h / (h + m):.6f})"
                 )
+    # optional guaranteed-tier refinement telemetry (PR 14 —
+    # Collector.note_refine): measured sweep counts and the worst landed
+    # backward error.  Absent without accuracy_tier='guaranteed' traffic;
+    # its gates are ``obs serve-report --max-refine-iters`` /
+    # ``--min-converged-frac``.
+    if "refine" in block:
+        rf = block["refine"]
+        if not isinstance(rf, dict):
+            probs.append(f"refine must be an object, got {rf!r}")
+        else:
+            for key in ("requests", "converged", "nonconverged",
+                        "iters_max"):
+                v = rf.get(key)
+                if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                    probs.append(
+                        f"refine.{key} must be a non-negative int, got {v!r}"
+                    )
+            cf = rf.get("converged_frac")
+            if not isinstance(cf, (int, float)) or not 0.0 <= cf <= 1.0:
+                probs.append(
+                    f"refine.converged_frac must be in [0, 1], got {cf!r}"
+                )
+            it = rf.get("iters")
+            if not isinstance(it, dict):
+                probs.append(f"refine.iters must be an object, got {it!r}")
+            else:
+                for p in _REQ_STATS_PCTS:
+                    if not isinstance(it.get(p), (int, float)):
+                        probs.append(f"refine.iters.{p} missing or "
+                                     "non-numeric")
+            rm = rf.get("resid_max")
+            if not isinstance(rm, (int, float)) or isinstance(rm, bool) \
+                    or rm < 0:
+                probs.append(
+                    f"refine.resid_max must be a non-negative number, "
+                    f"got {rm!r}"
+                )
     # multi-replica tags (serve/router.py, PR 9): a per-replica record
     # carries replica_id; the router's aggregate record carries replicas
     # (how many snapshots merged) and replica_ids.  Single-engine records
@@ -640,6 +677,72 @@ def validate_update_measured(measured) -> list[str]:
     return probs
 
 
+def validate_refine_measured(measured) -> list[str]:
+    """Schema problems of a bench:refine measured block ([] = valid) — the
+    mixed-precision iterative-refinement fields the refine driver emits
+    (the n/nrhs/batch geometry, the dtype pair, the f32-factor+IR vs
+    f64-factor speedup with its matched-residual ratio, and the TSQR
+    orthogonality probe).  Same exemption-with-validation posture as
+    blocktri / update: diff() validates every record whose metric starts
+    with "refine" (malformed -> LedgerIncompatible) while the metric
+    itself still compares normally — the value is a speedup ratio, so a
+    drop reads as "slower" like every other bench row."""
+    if not isinstance(measured, dict):
+        return [f"measured is {type(measured).__name__}, expected object"]
+    probs = []
+    for key in ("n", "nrhs", "batch"):
+        v = measured.get(key)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+            probs.append(f"{key} must be a positive int, got {v!r}")
+    for key in ("factor_dtype", "correction_dtype"):
+        v = measured.get(key)
+        if not isinstance(v, str) or not v:
+            probs.append(f"{key} must be a non-empty string, got {v!r}")
+    for key in ("speedup", "refined_ms", "baseline_ms"):
+        v = measured.get(key)
+        if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                or not v > 0:
+            probs.append(f"{key} must be a positive number, got {v!r}")
+    # how far the refined residual sits from the straight high-dtype
+    # factor's (1.0 = identical); the bench gate bounds it above
+    rr = measured.get("resid_ratio")
+    if not isinstance(rr, (int, float)) or isinstance(rr, bool) or rr < 0:
+        probs.append(
+            f"resid_ratio must be a non-negative number, got {rr!r}"
+        )
+    it = measured.get("iters")
+    if not isinstance(it, int) or isinstance(it, bool) or it < 0:
+        probs.append(f"iters must be a non-negative int, got {it!r}")
+    if "tsqr_ortho" in measured:
+        v = measured["tsqr_ortho"]
+        if not isinstance(v, (int, float)) or isinstance(v, bool) or v < 0:
+            probs.append(
+                f"tsqr_ortho must be a non-negative number, got {v!r}"
+            )
+    wm = measured.get("wall_ms")
+    if not isinstance(wm, dict):
+        probs.append(f"wall_ms must be an object, got {wm!r}")
+    else:
+        for p in _REQ_STATS_PCTS:
+            if not isinstance(wm.get(p), (int, float)):
+                probs.append(f"wall_ms.{p} missing or non-numeric")
+    # the tier serve smoke rides along only when the driver ran it;
+    # absent blocks stay valid unchanged
+    if "serve_smoke" in measured:
+        sm = measured["serve_smoke"]
+        if not isinstance(sm, dict):
+            probs.append(f"serve_smoke must be an object, got {sm!r}")
+        else:
+            for key in ("requests", "recompiles"):
+                v = sm.get(key)
+                if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                    probs.append(
+                        f"serve_smoke.{key} must be a non-negative int, "
+                        f"got {v!r}"
+                    )
+    return probs
+
+
 def _event_status(rec: dict) -> Optional[str]:
     """The robustness status of a record, when it carries one.
 
@@ -734,6 +837,14 @@ def diff(
             if probs:
                 raise LedgerIncompatible(
                     "malformed update bench record: " + "; ".join(probs)
+                )
+        if isinstance(meas, dict) and str(
+            meas.get("metric", "")
+        ).startswith("refine"):
+            probs = validate_refine_measured(meas)
+            if probs:
+                raise LedgerIncompatible(
+                    "malformed refine bench record: " + "; ".join(probs)
                 )
     a_by = {_key(r): r for r in a_recs}
     b_by = {_key(r): r for r in b_recs}

@@ -6,7 +6,7 @@ deterministic fault planting (robust/faultinject), and the sweep failure
 containment that lives in bench/harness + autotune/sweep.
 """
 
-from capital_tpu.robust import detect, faultinject, recovery
+from capital_tpu.robust import detect, faultinject, recovery, refine
 from capital_tpu.robust.config import CholEvent, RobustConfig, RobustInfo
 
 __all__ = [
@@ -16,4 +16,5 @@ __all__ = [
     "detect",
     "faultinject",
     "recovery",
+    "refine",
 ]

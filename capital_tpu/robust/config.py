@@ -40,6 +40,14 @@ class RobustConfig:
     ortho_tol: float | None = None
     recover: bool = True
     escalate: bool = True
+    #: run the blocked Householder TSQR (ops/tsqr.py, arXiv:0809.2407) as a
+    #: final in-graph escalation when the sCQR3 gate STILL fails — the
+    #: unconditionally stable refactorization that retires the info=n+2
+    #: dead end for matrices it can handle at the escalation compute dtype
+    #: (always-f64 where x64 is live).  Off by default: the documented
+    #: sentinel contract of the plain ladder is a measured envelope other
+    #: callers branch on; TSQR is an opt-in rung above it.
+    tsqr: bool = False
 
 
 class RobustInfo(NamedTuple):
@@ -52,8 +60,22 @@ class RobustInfo(NamedTuple):
     breakdown: object  # int32: chol sites whose unshifted factor broke
     shifted: object  # int32: sites re-factored with the gram shift
     sigma: object  # float32: largest shift applied (0.0 on the healthy path)
-    escalated: object  # int32: 1 when the sCQR3 third sweep ran
+    escalated: object  # int32: 1 = sCQR3 third sweep ran; 2 = TSQR rung ran
     ortho: object  # float32: escalation gate value; -1.0 when not computed
+    # WHICH gate a nonzero `info` came from, so escalation routing can
+    # distinguish them (GATE_NONE/GATE_ORTHO/GATE_RESIDUAL below): 1 means
+    # the orthogonality gate ||I - QᵀQ||_F/sqrt(n) still exceeded tolerance
+    # after the ladder (the TSQR-recoverable case), 2 means a residual
+    # factor status survived recovery (non-finite/indefinite input — no
+    # amount of re-factorization helps).  Defaulted so pre-existing
+    # keyword-style constructions stay valid.
+    gate: object = 0  # int32
+
+
+#: RobustInfo.gate vocabulary.
+GATE_NONE = 0
+GATE_ORTHO = 1  # orthogonality gate failed (escalate via TSQR)
+GATE_RESIDUAL = 2  # residual factor status nonzero (operand is bad)
 
 
 class CholEvent(NamedTuple):

@@ -20,6 +20,7 @@ reduction and the recovery work compiles into the cold branch.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -85,3 +86,40 @@ def guarded_chol(G, m_rows: int, rcfg: RobustConfig | None, chol_fn):
     return R2, Rinv2, CholEvent(
         info=info, sigma=applied, info_after=detect.factor_info(R2)
     )
+
+
+# --------------------------------------------------------------------------
+# the rung above sCQR3: Householder TSQR escalation (ops/tsqr.py)
+# --------------------------------------------------------------------------
+
+
+def escalation_dtype(dtype):
+    """The compute dtype of the TSQR escalation rung: ALWAYS f64 where x64
+    is live — escalation means the caller has already paid recovery sweeps
+    and wants accuracy, not dtype preservation (cond beyond the f32 shift
+    envelope needs u ~ 1e-16 to recover at all).  On x64-disabled rigs the
+    rule degrades honestly to f32: canonicalize_dtype reports what the
+    runtime can actually represent, the gate measurement then says whether
+    that was enough."""
+    del dtype  # the rule is unconditional; the arg documents the call sites
+    return jnp.dtype(jax.dtypes.canonicalize_dtype(jnp.float64))
+
+
+def tsqr_escalate(A, *, precision: str | None = "highest"):
+    """Re-factor A with the blocked Householder TSQR (ops/tsqr,
+    arXiv:0809.2407) at the escalation dtype — the target the robust
+    ladder routes to when `RobustInfo.gate == GATE_ORTHO` (the CQR family
+    is out of envelope at A's precision but the matrix itself is fine).
+
+    Returns (Q, R, ortho) AT THE ESCALATION DTYPE: ortho is the measured
+    final gate ||I − QᵀQ||_F/sqrt(n), never an assumption, so callers
+    branch on it exactly like RobustInfo.ortho.  TSQR never forms a gram,
+    so at f64 this recovers cond(A) up to ~u⁻¹ ≈ 1e15 where sCQR3 stalls
+    (docs/ROBUSTNESS.md escalation ladder)."""
+    # local import: robust/__init__ imports this module, and ops/lapack
+    # imports the robust package — a top-level ops import here would cycle
+    from capital_tpu.ops import tsqr as tsqr_mod
+
+    ct = escalation_dtype(A.dtype)
+    Q, R = tsqr_mod.tsqr(A.astype(ct), precision=precision)
+    return Q, R, tsqr_mod.ortho_gate(Q, precision)

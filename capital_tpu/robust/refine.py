@@ -1,0 +1,424 @@
+"""Mixed-precision iterative refinement: f64-grade answers at bf16/f32
+factor throughput.
+
+Single-chip dense throughput is saturated (PERF.md round 13), so the next
+hot-path win does the O(n³) work in a CHEAPER precision and buys the
+accuracy back with O(n²) sweeps: factor once at a low dtype, then iterate
+
+    r = B − A·X          (residual, HIGH precision — IR::residual)
+    d = solve(factor, r) (correction against the resident factor — IR::correct)
+    X = X + d
+
+Classic Wilkinson iterative refinement: each sweep contracts the error by
+~cond(A)·u_factor, so whenever cond(A) is inside the factor dtype's
+envelope a handful of sweeps reach the CORRECTION dtype's backward error —
+the f32-factor + f64-correction combo lands f64-grade residuals at f32
+factor cost (the `make bench-refine` gate).  The cond≈2e4 point where f32
+sCQR3 stalls (docs/ROBUSTNESS.md) is comfortably inside this envelope:
+contraction per sweep there is ~2e-3.
+
+Everything is jit-friendly: the sweep loop is a `lax.while_loop` with an
+IN-PROGRAM convergence test (per-problem normwise backward error
+``‖r‖ / (‖A‖·‖X‖ + ‖B‖)`` against a dtype-derived tolerance), a fixed
+iteration cap, and a progress guard — a problem whose error stops halving
+freezes immediately, so divergence (cond beyond the factor envelope, or a
+broken factor) costs at most one wasted sweep and comes back LOUD as
+``RefineInfo.converged == 0`` with the measured final error.  All dtype
+resolution is static (trace-time), so serve's zero-recompile invariant
+holds; per-problem iteration counts come back as arrays for the stats
+layer (serve/stats.Collector `refine` block).
+
+Three flagship drivers, all batched (leading batch axis, the serve bucket
+layout):
+
+* ``posv`` — dense SPD; factor rides the PR 6 batched-grid potrf behind
+  the dispatch-gate resolver, corrections are two triangular sweeps
+  against the VMEM-resident-convention factor.
+* ``lstsq`` — tall-skinny least squares via the CQR seam: the gram
+  Cholesky R (= A's R factor) plus SEMI-NORMAL-EQUATION corrections
+  (Björck): d = R⁻¹R⁻ᵀ·Aᵀr.
+* ``posv_blocktri`` — the chain factors once (or reuses a RESIDENT factor
+  from PR 12's residency cache via ``factor=``) and each correction sweep
+  is the O(n·b²) block-bidiagonal substitution, not a refactor.
+
+The serve tier vocabulary (``accuracy_tier`` ∈ fast/balanced/guaranteed)
+resolves here (`plan`): balanced keeps today's program byte-identical,
+fast downgrades the factor dtype one notch without refinement (the cheap
+tier under overload, ROADMAP item 3), guaranteed pairs a low factor dtype
+with an upgraded correction dtype and a sweep cap.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from capital_tpu.utils import tracing
+
+TIERS = ("fast", "balanced", "guaranteed")
+
+#: Sweep cap of the guaranteed tier: IR inside the envelope converges in
+#: 2-4 sweeps (contraction ~cond·u_factor per sweep); 8 leaves margin for
+#: near-envelope cond without letting a divergent problem spin.
+DEFAULT_MAX_ITERS = 8
+
+
+class RefineInfo(NamedTuple):
+    """Per-problem refinement outcome (a pytree of (batch,) arrays,
+    jit/vmap-safe — rides the executor's extras slot between X and the
+    trailing info, so every request lands with its own counts)."""
+
+    iters: object  # int32: correction sweeps executed
+    converged: object  # int32: 1 = backward error met tolerance
+    resid: object  # float32: final normwise backward-error estimate
+
+
+class TierPlan(NamedTuple):
+    """Static resolution of one accuracy tier at one request dtype."""
+
+    factor_dtype: object
+    correction_dtype: object
+    max_iters: int  # 0 = no refinement (the factor answer ships as-is)
+
+
+def _down1(dtype):
+    """One notch down the factor ladder: f64→f32, f32→bf16, bf16 floors."""
+    dt = jnp.dtype(dtype)
+    if dt == jnp.float64:
+        return jnp.dtype(jnp.float32)
+    return jnp.dtype(jnp.bfloat16)
+
+
+def _up(dtype):
+    """One notch up for corrections: bf16→f32, f32→f64 (where x64 is
+    live — canonicalize_dtype reports what the runtime represents, so the
+    resolution stays static AND honest on x64-disabled rigs), f64 ceils."""
+    dt = jnp.dtype(dtype)
+    if dt.itemsize < 4:
+        return jnp.dtype(jnp.float32)
+    return jnp.dtype(jax.dtypes.canonicalize_dtype(jnp.float64))
+
+
+def plan(tier: str, dtype) -> TierPlan:
+    """Resolve accuracy_tier → (factor dtype, correction dtype, sweep cap)
+    for one request dtype.  Pure static function of (tier, dtype): the
+    serve engine hashes the tier into the bucket key and executable
+    cfg-hash, and every downstream dispatch reads only these dtypes — the
+    zero-recompile invariant survives the precision knob.
+
+    * balanced — today's program, byte-identical (no refinement).
+    * fast — factor one notch down, no refinement: the cheap tier the
+      SLO-aware scheduler sheds to under overload.
+    * guaranteed — low factor + upgraded correction + sweep cap: f64
+      requests factor in f32 and correct in f64 (the bench flagship),
+      f32 factors in f32 and corrects in f64, bf16 factors in bf16 and
+      corrects in f32.
+    """
+    dt = jnp.dtype(dtype)
+    if tier not in TIERS:
+        raise ValueError(f"accuracy_tier must be one of {TIERS}, got {tier!r}")
+    if tier == "balanced":
+        return TierPlan(dt, dt, 0)
+    if tier == "fast":
+        fd = _down1(dt)
+        return TierPlan(fd, fd, 0)
+    fd = jnp.dtype(jnp.float32) if dt == jnp.float64 else dt
+    return TierPlan(fd, _up(dt), DEFAULT_MAX_ITERS)
+
+
+def tolerance(n: int, correction_dtype) -> float:
+    """Default convergence tolerance on the normwise backward error:
+    0.5·sqrt(n)·u at the CORRECTION dtype.  The measured floor of the
+    refined error is ~0.02·sqrt(n)·u (residual rounding is a random walk
+    over the n·k contraction terms, and the ‖A‖·‖X‖ scale sits in the
+    denominator), so this demands a genuinely correction-dtype-grade
+    answer — the bench gate compares against a straight f64 factor and
+    this tolerance lands within ~1x of it — while keeping ~25x headroom
+    above the floor so the progress guard doesn't fire loud false
+    failures at the last sweep."""
+    return 0.5 * float(n) ** 0.5 * float(
+        jnp.finfo(jnp.dtype(correction_dtype)).eps
+    )
+
+
+def _pnorm(X):
+    """Per-problem Frobenius norm of a (batch, ...) stack, as f32."""
+    flat = X.reshape(X.shape[0], -1)
+    return jnp.sqrt(jnp.sum(jnp.square(flat), axis=-1)).astype(jnp.float32)
+
+
+def _refine_loop(X0, resid_fn, err_fn, correct_fn, *, max_iters: int,
+                 tol: float):
+    """The shared sweep loop.  resid_fn(X) -> r at the correction dtype;
+    err_fn(X, r) -> per-problem (batch,) f32 backward error; correct_fn(r)
+    -> d.  Per-problem freezing: a problem stops the moment it converges,
+    stops improving (error not halved — divergence comes back loud, not
+    spun on), or hits the cap; the while_loop runs until every problem
+    froze.  Returns (X, RefineInfo)."""
+    batch = X0.shape[0]
+    r0 = resid_fn(X0)
+    e0 = err_fn(X0, r0)
+
+    def _active(e, prev, it):
+        return (e > tol) & (e < 0.5 * prev) & (it < max_iters)
+
+    def cond(carry):
+        _, _, e, prev, it = carry
+        return jnp.any(_active(e, prev, it))
+
+    def body(carry):
+        X, r, e, prev, it = carry
+        act = _active(e, prev, it)
+        d = correct_fn(r)
+        mask = act.reshape((batch,) + (1,) * (X.ndim - 1))
+        Xn = X + jnp.where(mask, d, jnp.zeros_like(d))
+        rn = resid_fn(Xn)
+        en = err_fn(Xn, rn)
+        return (
+            Xn,
+            jnp.where(mask, rn, r),
+            jnp.where(act, en, e),
+            jnp.where(act, e, prev),
+            it + act.astype(jnp.int32),
+        )
+
+    X, _, e, _, it = lax.while_loop(
+        cond, body,
+        (X0, r0, e0, jnp.full((batch,), jnp.inf, jnp.float32),
+         jnp.zeros((batch,), jnp.int32)),
+    )
+    info = RefineInfo(
+        iters=it, converged=(e <= tol).astype(jnp.int32), resid=e
+    )
+    return X, info
+
+
+# --------------------------------------------------------------------------
+# factor/solve routing: the PR 6 dispatch gate, at the FACTOR dtype
+# --------------------------------------------------------------------------
+
+
+def _potrf_route(Af, k: int, impl: str, precision, interpret):
+    """Batched potrf at the factor dtype behind the batched_small
+    dispatch-gate resolver: (R, info) with R upper.  Static resolution —
+    f64 factors always ride the vmap/LAPACK seam (dtype_capable)."""
+    from capital_tpu.ops import batched_small, lapack
+
+    batch, n, _ = Af.shape
+    pick = impl
+    if impl == "auto":
+        pick = batched_small.default_impl(
+            "posv", Af.shape, (batch, n, k), Af.dtype, interpret=interpret
+        )
+    elif impl in ("pallas", "pallas_split") and not batched_small.dtype_capable(
+        Af.dtype
+    ):
+        pick = "vmap"
+    if pick in ("pallas", "pallas_split"):
+        R, info = batched_small.potrf(
+            Af, uplo="U", precision=precision, interpret=interpret
+        )
+        solve = lambda rr, bb: batched_small.potrs(
+            rr, bb, uplo="U", precision=precision, interpret=interpret
+        )
+        return R, info, solve
+    with tracing.scope("serve::solve"):
+        R, info = jax.vmap(
+            lambda a: lapack.potrf(a, uplo="U", with_info=True)
+        )(Af)
+    return R, info, lambda rr, bb: lapack.potrs(rr, bb, uplo="U")
+
+
+# --------------------------------------------------------------------------
+# the three flagship drivers
+# --------------------------------------------------------------------------
+
+
+def posv(A, B, *, factor_dtype, correction_dtype,
+         max_iters: int = DEFAULT_MAX_ITERS, tol: float | None = None,
+         impl: str = "auto", precision: str | None = "highest",
+         interpret: bool | None = None):
+    """Refined batched SPD solve: (batch, n, n) × (batch, n, k) →
+    (X, info, RefineInfo) with X at B.dtype, info the (batch,) int32
+    factor status (potrf convention — refinement cannot repair a broken
+    factor, it reports it)."""
+    batch, n, _ = A.shape
+    k = B.shape[-1]
+    fd, cd = jnp.dtype(factor_dtype), jnp.dtype(correction_dtype)
+    if tol is None:
+        tol = tolerance(n, cd)
+
+    R, info, solve = _potrf_route(A.astype(fd), k, impl, precision, interpret)
+    Ac, Bc = A.astype(cd), B.astype(cd)
+    anorm = _pnorm(Ac)
+    bnorm = _pnorm(Bc)
+    tiny = jnp.float32(jnp.finfo(jnp.float32).tiny)
+
+    with tracing.scope("IR::residual"):
+        tracing.emit(flops=batch * 2.0 * n * n * k)
+    with tracing.scope("IR::correct"):
+        tracing.emit(
+            flops=batch * (tracing.refine_sweep_flops(n, k)
+                           - 2.0 * n * n * k)
+        )
+
+    def resid(X):
+        with tracing.scope("IR::residual"):
+            return Bc - jnp.matmul(Ac, X, precision=precision)
+
+    def err(X, r):
+        return _pnorm(r) / (anorm * _pnorm(X) + bnorm + tiny)
+
+    def correct(r):
+        with tracing.scope("IR::correct"):
+            return solve(R, r.astype(fd)).astype(cd)
+
+    X0 = correct(Bc - jnp.zeros_like(Bc))  # first solve IS a correction of 0
+    X, rinfo = _refine_loop(X0, resid, err, correct,
+                            max_iters=max_iters, tol=tol)
+    return X.astype(B.dtype), info, rinfo
+
+
+def lstsq(A, B, *, factor_dtype, correction_dtype,
+          max_iters: int = DEFAULT_MAX_ITERS, tol: float | None = None,
+          impl: str = "auto", precision: str | None = "highest",
+          interpret: bool | None = None):
+    """Refined batched least squares via the CQR seam + semi-normal
+    corrections: the gram Cholesky R (A's triangular factor up to signs)
+    is computed ONCE at the factor dtype, then every sweep solves
+    d = R⁻¹R⁻ᵀ·Aᵀr at factor cost O(mnk + n²k) — no re-factorization.
+    Convergence is measured on the NORMAL-equation residual Aᵀ(B − AX)
+    (the quantity lstsq actually zeroes; the plain residual floors at the
+    data's distance from range(A))."""
+    batch, m, n = A.shape
+    k = B.shape[-1]
+    fd, cd = jnp.dtype(factor_dtype), jnp.dtype(correction_dtype)
+    if tol is None:
+        tol = tolerance(n, cd)
+
+    from capital_tpu.ops import batched_small  # noqa: F401  (route below)
+
+    Af = A.astype(fd)
+    with tracing.scope("CQR::gram"):
+        G = jnp.matmul(jnp.swapaxes(Af, -1, -2), Af, precision=precision)
+    R, info, solve = _potrf_route(G, k, impl, precision, interpret)
+
+    Ac, Bc = A.astype(cd), B.astype(cd)
+    At = jnp.swapaxes(Ac, -1, -2)
+    C0 = jnp.matmul(At, Bc, precision=precision)  # AᵀB at corr dtype
+    anorm2 = jnp.square(_pnorm(Ac))
+    cnorm = _pnorm(C0)
+    tiny = jnp.float32(jnp.finfo(jnp.float32).tiny)
+
+    with tracing.scope("IR::residual"):
+        tracing.emit(flops=batch * 4.0 * m * n * k)
+    with tracing.scope("IR::correct"):
+        tracing.emit(
+            flops=batch * (tracing.refine_lstsq_sweep_flops(m, n, k)
+                           - 4.0 * m * n * k)
+        )
+
+    def resid(X):
+        # the semi-normal residual g = Aᵀ(B − A·X), at the corr dtype
+        with tracing.scope("IR::residual"):
+            r = Bc - jnp.matmul(Ac, X, precision=precision)
+            return jnp.matmul(At, r, precision=precision)
+
+    def err(X, g):
+        return _pnorm(g) / (anorm2 * _pnorm(X) + cnorm + tiny)
+
+    def correct(g):
+        with tracing.scope("IR::correct"):
+            return solve(R, g.astype(fd)).astype(cd)
+
+    X0 = correct(C0)
+    X, rinfo = _refine_loop(X0, resid, err, correct,
+                            max_iters=max_iters, tol=tol)
+    return X.astype(B.dtype), info, rinfo
+
+
+def _chain_matvec(D, Cz, X, precision):
+    """y = A·X for the block-tridiagonal chain (D diagonal blocks, Cz
+    sub-diagonal blocks with block 0 ZEROED — the blocktri packing
+    convention): y_i = D_i·X_i + C_i·X_{i−1} + C_{i+1}ᵀ·X_{i+1}."""
+    y = jnp.matmul(D, X, precision=precision)
+    Xdown = jnp.concatenate([jnp.zeros_like(X[:, :1]), X[:, :-1]], axis=1)
+    y = y + jnp.matmul(Cz, Xdown, precision=precision)
+    CzT = jnp.swapaxes(Cz, -1, -2)
+    CzTup = jnp.concatenate(
+        [CzT[:, 1:], jnp.zeros_like(CzT[:, :1])], axis=1
+    )
+    Xup = jnp.concatenate([X[:, 1:], jnp.zeros_like(X[:, :1])], axis=1)
+    return y + jnp.matmul(CzTup, Xup, precision=precision)
+
+
+def posv_blocktri(D, C, B, *, factor_dtype, correction_dtype,
+                  max_iters: int = DEFAULT_MAX_ITERS,
+                  tol: float | None = None, impl: str = "auto",
+                  precision: str | None = "highest",
+                  interpret: bool | None = None, factor=None):
+    """Refined block-tridiagonal SPD solve: the chain factors ONCE at the
+    factor dtype (or reuses a RESIDENT (L, Wt) factor via ``factor=`` —
+    the PR 12 residency-cache composition: refinement then never
+    refactors at all) and every correction sweep is the O(n·b²)
+    block-bidiagonal substitution (models/blocktri.solve, BT::solve).
+    Shapes per models/blocktri: D, C (batch, nblocks, b, b), B (batch,
+    nblocks, b, k)."""
+    from capital_tpu.models import blocktri
+
+    batch, nblocks, b, _ = D.shape
+    k = B.shape[-1]
+    n = nblocks * b
+    fd, cd = jnp.dtype(factor_dtype), jnp.dtype(correction_dtype)
+    if tol is None:
+        tol = tolerance(n, cd)
+    mapped = {"auto": "auto", "pallas": "pallas", "pallas_split": "pallas",
+              "vmap": "xla", "xla": "xla"}[impl]
+
+    if factor is None:
+        L, Wt, info = blocktri.factor(
+            D.astype(fd), C.astype(fd), precision=precision, impl=mapped,
+            interpret=interpret,
+        )
+    else:
+        L, Wt = factor
+        info = jnp.zeros((batch,), jnp.int32)  # resident factors install clean
+
+    Dc, Cc = D.astype(cd), C.astype(cd)
+    # zero the (meaningless) first coupling block at the corr dtype too —
+    # the factor path does this internally (blocktri._zero_first_coupling)
+    Cz = jnp.concatenate([jnp.zeros_like(Cc[:, :1]), Cc[:, 1:]], axis=1)
+    Bc = B.astype(cd)
+    anorm = jnp.sqrt(
+        jnp.square(_pnorm(Dc)) + 2.0 * jnp.square(_pnorm(Cz))
+    )
+    bnorm = _pnorm(Bc)
+    tiny = jnp.float32(jnp.finfo(jnp.float32).tiny)
+
+    with tracing.scope("IR::residual"):
+        tracing.emit(flops=batch * nblocks * (2.0 * b * b * k * 3.0))
+    with tracing.scope("IR::correct"):
+        tracing.emit(
+            flops=batch * 2.0 * tracing.blocktri_solve_flops(nblocks, b, k)
+        )
+
+    def resid(X):
+        with tracing.scope("IR::residual"):
+            return Bc - _chain_matvec(Dc, Cz, X, precision)
+
+    def err(X, r):
+        return _pnorm(r) / (anorm * _pnorm(X) + bnorm + tiny)
+
+    def correct(r):
+        with tracing.scope("IR::correct"):
+            d = blocktri.solve(L, Wt, r.astype(fd), precision=precision,
+                               impl=mapped, interpret=interpret)
+            return d.astype(cd)
+
+    X0 = correct(Bc)
+    X, rinfo = _refine_loop(X0, resid, err, correct,
+                            max_iters=max_iters, tol=tol)
+    return X.astype(B.dtype), info, rinfo

@@ -320,9 +320,42 @@ def _batched_extend(precision, impl: str):
     return f
 
 
+def _batched_refine(op: str, precision, impl: str, tier: str):
+    """The guaranteed-tier bucket program: mixed-precision iterative
+    refinement (robust/refine) over the flagship solve.  FIVE outputs —
+    (X, iters, converged, resid, info) — so the executor's extras slot
+    carries each request's refinement facts to the engine's refine sink
+    (stats + the loud non-convergence contract).  All dtype resolution
+    (refine.plan) reads only the static operand dtype, so one compile per
+    (bucket, tier) and the zero-recompile invariant holds."""
+    from capital_tpu.robust import refine
+
+    def f(a, b):
+        p = refine.plan(tier, a.dtype)
+        kw = dict(factor_dtype=p.factor_dtype,
+                  correction_dtype=p.correction_dtype,
+                  max_iters=p.max_iters, impl=impl, precision=precision)
+        if op == "posv":
+            X, info, ri = refine.posv(a, b, **kw)
+        elif op == "lstsq":
+            X, info, ri = refine.lstsq(a, b, **kw)
+        else:  # posv_blocktri (bucket packing: a[:, 0]=D, a[:, 1]=C)
+            X, info, ri = refine.posv_blocktri(a[:, 0], a[:, 1], b, **kw)
+        return X, ri.iters, ri.converged, ri.resid, info
+
+    return f
+
+
+#: the ops the accuracy-tier vocabulary applies to — the three flagship
+#: solves refine.py wraps.  Everything else (inv, the factor-residency
+#: ops) rejects a non-balanced tier loudly rather than silently serving
+#: the balanced program under a tier label.
+TIER_OPS = ("posv", "lstsq", "posv_blocktri")
+
+
 def batched(op: str, precision: str | None = "highest",
             impl: str = "auto", *, blocktri_impl: str = "auto",
-            blocktri_partitions: int = 0):
+            blocktri_partitions: int = 0, tier: str = "balanced"):
     """The function the engine AOT-compiles for one bucket: maps the fixed
     (capacity, *problem) batch through the per-problem kernel, returning
     (X, info) stacks.
@@ -335,12 +368,44 @@ def batched(op: str, precision: str | None = "highest",
     `blocktri_impl` / `blocktri_partitions` reach only the posv_blocktri
     program (`_batched_blocktri` — the partitioned-vs-scan algorithm
     knob; config-hashed by the engine).
+
+    `tier` is the request's accuracy tier (robust/refine.TIERS, part of
+    the bucket key): 'balanced' compiles today's program byte-identical;
+    'fast' runs it with the factor dtype one notch down (refine._down1 —
+    bf16/f32 factor throughput, answers cast back to the request dtype,
+    NO refinement: the overload-shedding tier); 'guaranteed' compiles the
+    iterative-refinement program (`_batched_refine` — low-precision
+    factor, high-precision correction sweeps, five outputs).  Only the
+    flagship TIER_OPS accept a non-balanced tier.
     """
     if impl not in batched_small.IMPLS:
         raise ValueError(
             f"unknown batched impl {impl!r}: expected one of "
             f"{batched_small.IMPLS}"
         )
+    if tier != "balanced":
+        from capital_tpu.robust import refine
+
+        if tier not in refine.TIERS:
+            raise ValueError(
+                f"accuracy_tier must be one of {refine.TIERS}, got {tier!r}"
+            )
+        if op not in TIER_OPS:
+            raise ValueError(
+                f"accuracy_tier={tier!r} applies only to {TIER_OPS}; "
+                f"op {op!r} serves the balanced program only"
+            )
+        if tier == "guaranteed":
+            return _batched_refine(op, precision, impl, tier)
+        inner = batched(op, precision, impl, blocktri_impl=blocktri_impl,
+                        blocktri_partitions=blocktri_partitions)
+
+        def fast(a, b):
+            fd = refine.plan("fast", a.dtype).factor_dtype
+            X, info = inner(a.astype(fd), b.astype(fd))
+            return X.astype(a.dtype), info
+
+        return fast
     if op == "posv_blocktri":
         return _batched_blocktri(precision, impl, blocktri_impl,
                                  blocktri_partitions)

@@ -56,11 +56,18 @@ class Bucket:
     a_shape: tuple[int, ...]
     b_shape: tuple[int, ...] | None
     capacity: int
+    #: requested accuracy tier (robust/refine.TIERS).  Part of the key:
+    #: tiers compile DIFFERENT programs (factor dtype, refinement loop),
+    #: so same-shape requests at different tiers must land in different
+    #: buckets — mixing them would either refine everyone (latency tax on
+    #: fast traffic) or no one (silent accuracy downgrade).  Defaulted so
+    #: pre-tier constructions and cache keys stay valid.
+    tier: str = "balanced"
 
     @property
     def key(self) -> tuple:
         return (self.op, self.dtype, self.a_shape, self.b_shape,
-                self.capacity)
+                self.capacity, self.tier)
 
 
 def _pick(ladder: tuple[int, ...], v: int) -> int | None:
@@ -72,10 +79,14 @@ def _pick(ladder: tuple[int, ...], v: int) -> int | None:
     return best
 
 
-def bucket_for(op: str, a_shape, b_shape, dtype: str, cfg) -> Bucket | None:
+def bucket_for(op: str, a_shape, b_shape, dtype: str, cfg,
+               *, tier: str = "balanced") -> Bucket | None:
     """Resolve a request's operand shapes to a bucket, or None when any
     dimension exceeds its ladder (the engine then routes the request
     unbatched through the models/ paths — `oversize` policy).
+
+    `tier` stamps the accuracy tier into the bucket key (geometry is
+    tier-independent — tiers change the PROGRAM, not the padded shapes).
 
     lstsq rows bucket at `m + (nb - n)`: the column pad appends one unit
     column PER padded column and each needs its own appended row
@@ -95,6 +106,15 @@ def bucket_for(op: str, a_shape, b_shape, dtype: str, cfg) -> Bucket | None:
     (2, nblocks, b, b), resident carry (b, b))."""
     if op not in OPS and op not in MISS_OPS:
         raise ValueError(f"unknown serve op {op!r}; expected one of {OPS}")
+    if tier != "balanced":
+        from capital_tpu.robust import refine
+
+        if tier not in refine.TIERS:
+            raise ValueError(
+                f"accuracy_tier must be one of {refine.TIERS}, got {tier!r}"
+            )
+        b = bucket_for(op, a_shape, b_shape, dtype, cfg)
+        return None if b is None else dataclasses.replace(b, tier=tier)
     if op in ("chol_update", "chol_downdate"):
         nb = _pick(cfg.buckets, a_shape[0])
         kb = _pick(cfg.nrhs_buckets, b_shape[1])

@@ -237,7 +237,16 @@ class SolveEngine:
         impl = self.cfg.small_n_impl
         if impl == "vmap":
             return False
-        if not batched_small.dtype_capable(bucket.dtype):
+        # tiered buckets factor at the PLAN's dtype, not the request's —
+        # a guaranteed f64 bucket factors in f32 and CAN take the
+        # batched-grid kernels (the whole point of the tier); resolve
+        # capability against what the compiled program actually factors in
+        dtype = bucket.dtype
+        if bucket.tier != "balanced":
+            from capital_tpu.robust import refine
+
+            dtype = str(refine.plan(bucket.tier, bucket.dtype).factor_dtype)
+        if not batched_small.dtype_capable(dtype):
             # forced pallas included: api._batched_pallas falls back to the
             # vmap program for f64, so the executable is NOT small-route
             return False
@@ -253,7 +262,7 @@ class SolveEngine:
             seg = blocktri.resolve_seg(nblocks)
             k = bucket.b_shape[2] if bucket.op == "posv_blocktri" else b
             return blocktri_small.default_impl(
-                b, k, seg, bucket.dtype
+                b, k, seg, dtype
             ) == "pallas"
         if bucket.op in ("chol_update", "chol_downdate"):
             if impl in ("pallas", "pallas_split"):
@@ -269,19 +278,19 @@ class SolveEngine:
             a_shape = (bucket.capacity,) + bucket.a_shape
             b_shape = (bucket.capacity,) + bucket.b_shape
             return batched_small.default_impl(
-                "posv", a_shape, b_shape, bucket.dtype
+                "posv", a_shape, b_shape, dtype
             ) == "pallas"
         a_shape = (bucket.capacity,) + bucket.a_shape
         if bucket.op == "inv":
             # inv rides the posv kernel with an identity RHS (api.batched):
             # eligibility is posv's with b_shape == a_shape
             return batched_small.default_impl(
-                "posv", a_shape, a_shape, bucket.dtype
+                "posv", a_shape, a_shape, dtype
             ) == "pallas"
         b_shape = ((bucket.capacity,) + bucket.b_shape
                    if bucket.b_shape is not None else None)
         return batched_small.default_impl(
-            bucket.op, a_shape, b_shape, bucket.dtype
+            bucket.op, a_shape, b_shape, dtype
         ) == "pallas"
 
     def _blocktri_algorithm(self, nblocks: int, dtype) -> str:
@@ -321,7 +330,8 @@ class SolveEngine:
             fn = api.batched(bucket.op, self.cfg.precision,
                              self.cfg.small_n_impl,
                              blocktri_impl=self.cfg.blocktri_impl,
-                             blocktri_partitions=self.cfg.blocktri_partitions)
+                             blocktri_partitions=self.cfg.blocktri_partitions,
+                             tier=bucket.tier)
             exe = jax.jit(fn, donate_argnums=dn).lower(*specs).compile()
             if self.validate and dn:
                 from capital_tpu.lint import program as lint_program
@@ -364,17 +374,20 @@ class SolveEngine:
     def warmup(self, specs) -> int:
         """Pre-compile (or load from the persistent tier) executables for
         example request shapes.  `specs` is an iterable of (op, a_shape,
-        b_shape, dtype) — b_shape None for inv.  Shapes resolve through
-        the SAME bucket ladder as submit(), so warming one representative
-        per bucket covers every shape that maps there; oversize shapes
-        warm their exact-shape single route.  Returns the number of fresh
-        compiles (0 when every entry loaded from a warm persist_dir)."""
+        b_shape, dtype) or (op, a_shape, b_shape, dtype, accuracy_tier) —
+        b_shape None for inv, tier defaulting to 'balanced'.  Shapes
+        resolve through the SAME bucket ladder as submit(), so warming one
+        representative per bucket covers every shape that maps there;
+        oversize shapes warm their exact-shape single route.  Returns the
+        number of fresh compiles (0 when every entry loaded from a warm
+        persist_dir)."""
         before = self.cache.warmup_compiles
-        for op, a_shape, b_shape, dtype in specs:
+        for op, a_shape, b_shape, dtype, *rest in specs:
+            tier = rest[0] if rest else "balanced"
             dt = jnp.dtype(dtype)
             bucket = batching.bucket_for(
                 op, tuple(a_shape), tuple(b_shape) if b_shape else None,
-                str(dt), self.cfg,
+                str(dt), self.cfg, tier=tier,
             )
             if bucket is not None:
                 self._get_batched(bucket, warmup=True)
@@ -388,12 +401,23 @@ class SolveEngine:
     # ---- request path ------------------------------------------------------
 
     def submit(self, op: str, A, B=None, *,
-               factor_token: Optional[str] = None) -> Ticket:
+               factor_token: Optional[str] = None,
+               accuracy_tier: str = "balanced") -> Ticket:
         """Enqueue one solve request; returns a Ticket that resolves when
         its batch lands.  A capacity-full bucket DISPATCHES inside this
         call; under the continuous scheduler the dispatch is issued
         without waiting (the ticket is `done`, and `result()`/`pump()`/
         `drain()` land it).
+
+        `accuracy_tier` makes precision a scheduling dimension
+        (docs/SERVING.md "Accuracy tiers"): 'balanced' (default) runs the
+        request dtype end-to-end; 'fast' factors one dtype DOWN
+        (f64→f32, f32→bf16); 'guaranteed' factors in the fast dtype but
+        iteratively refines the answer back to the request dtype's
+        backward error (robust/refine), failing the request loudly if
+        refinement does not converge.  Tiers bucket separately — the tier
+        is part of the executable cache key — and are only defined for
+        posv / lstsq / posv_blocktri.
 
         `factor_token` names a resident factor for the factor-residency
         ops (docs/SERVING.md "Factor residency"): chol_update /
@@ -414,6 +438,11 @@ class SolveEngine:
         if op not in batching.OPS:
             raise ValueError(
                 f"unknown serve op {op!r}; expected one of {batching.OPS}"
+            )
+        if accuracy_tier != "balanced" and op not in api.TIER_OPS:
+            raise ValueError(
+                f"accuracy_tier={accuracy_tier!r} is only defined for "
+                f"{api.TIER_OPS}, got op {op!r}"
             )
         if op in batching.FACTOR_OPS:
             if factor_token is None:
@@ -462,8 +491,19 @@ class SolveEngine:
             return ticket
         bucket = batching.bucket_for(
             op, A.shape, B.shape if B is not None else None,
-            str(A.dtype), self.cfg,
+            str(A.dtype), self.cfg, tier=accuracy_tier,
         )
+        if bucket is None and accuracy_tier != "balanced":
+            # the oversize models/ route has no tiered program — silently
+            # serving a 'guaranteed' request at balanced precision (or a
+            # 'fast' one at full) would betray the contract, so fail loud
+            self.executor.fail(
+                ticket, op,
+                f"no bucket for {op} {A.shape}: accuracy_tier="
+                f"{accuracy_tier!r} requests have no oversize route",
+                t_enq,
+            )
+            return ticket
         if op == "posv_blocktri":
             # impl split: the bucketed program follows the engine's
             # algorithm knobs; the oversize single route runs posv's own
@@ -483,8 +523,11 @@ class SolveEngine:
                 self._run_single(ticket, op, A, B, t_enq)
             return ticket
         pa, pb = batching.pad_operands(op, A, B, bucket)
+        sink = (self._refine_sink(op) if bucket.tier == "guaranteed"
+                else None)
         self._admit(ticket, bucket, pa, pb, tuple(A.shape),
-                    tuple(B.shape) if B is not None else None, t_enq)
+                    tuple(B.shape) if B is not None else None, t_enq,
+                    sink=sink)
         return ticket
 
     def pump(self, now: Optional[float] = None) -> int:
@@ -502,9 +545,11 @@ class SolveEngine:
         return self.scheduler.drain()
 
     def solve(self, op: str, A, B=None, *,
-              factor_token: Optional[str] = None) -> Response:
+              factor_token: Optional[str] = None,
+              accuracy_tier: str = "balanced") -> Response:
         """Convenience synchronous path: submit + drain + result."""
-        ticket = self.submit(op, A, B, factor_token=factor_token)
+        ticket = self.submit(op, A, B, factor_token=factor_token,
+                             accuracy_tier=accuracy_tier)
         if not ticket.done:
             self.drain()
         return ticket.result()
@@ -847,6 +892,33 @@ class SolveEngine:
                 {"b": b, "nblocks": int(L.shape[0]),
                  "dtype": str(L.dtype)},
             )
+            return x, raw_info, None
+
+        return sink
+
+    def _refine_sink(self, op: str):
+        """Landing hook for accuracy_tier='guaranteed' buckets: the tiered
+        program (api._batched_refine) lands (X, iters, converged, resid)
+        per request.  Record the measured refinement cost into the stats
+        (sweep counts are data-dependent — they CANNOT be priced at trace
+        time, which is why tracing only prices one sweep), and fail the
+        request loudly when the refinement loop froze before reaching the
+        correction-dtype backward-error tolerance: a 'guaranteed' answer
+        that isn't is worse than an error."""
+
+        def sink(x, extras, raw_info):
+            it, conv, resid = (int(extras[0]), int(extras[1]),
+                               float(extras[2]))
+            self.stats.note_refine(it, bool(conv), resid)
+            if not conv:
+                return x, raw_info, (
+                    f"accuracy_tier='guaranteed' {op} did not converge: "
+                    f"refinement froze after {it} sweep(s) at backward "
+                    f"error {resid:.3e} (stalled or diverging — the "
+                    "operand is likely too ill-conditioned for the "
+                    "factor dtype; resubmit at tier='balanced' in a "
+                    "wider dtype)"
+                )
             return x, raw_info, None
 
         return sink

@@ -165,8 +165,15 @@ class Executor:
         resident originals in the FactorCache stay intact); posv_cached
         donates its RHS like posv.  The miss and extend programs donate
         nothing (3-output / carry-shaped operands XLA would drop the
-        declaration for)."""
+        declaration for).
+
+        Tiered buckets donate nothing: the fast program downcasts the
+        request-dtype inputs before factoring (different itemsize — XLA
+        would drop the alias), and the guaranteed program keeps BOTH
+        operands live across every refinement sweep's residual."""
         if not self.donate():
+            return ()
+        if bucket.tier != "balanced":
             return ()
         if bucket.op in ("chol_update", "chol_downdate"):
             return (0,)
@@ -289,9 +296,11 @@ class Executor:
                 info=int(raw.info), breakdown=int(raw.breakdown),
                 shifted=int(raw.shifted), sigma=float(raw.sigma),
                 escalated=int(raw.escalated), ortho=float(raw.ortho),
+                gate=int(raw.gate),
             )
         i = int(raw)
         # detect-only sites surface the potrf convention; no recovery ran
+        # (and no gate was evaluated — gate stays GATE_NONE)
         return RobustInfo(info=i, breakdown=int(i != 0), shifted=0,
                           sigma=0.0, escalated=0, ortho=-1.0)
 

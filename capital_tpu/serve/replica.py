@@ -136,9 +136,14 @@ def _serve_loop(replica_id: str, cfg_kwargs: dict,
         """Apply one inbox message; True means exit the loop."""
         kind = msg[0]
         if kind == "submit":
-            _, rid, op, A, B = msg
+            # 5-tuple is the pre-tier wire format; a trailing element is
+            # the accuracy_tier (sent only when non-balanced, so mixed
+            # router/replica versions interoperate on balanced traffic)
+            _, rid, op, A, B, *rest = msg
+            tier = rest[0] if rest else "balanced"
             try:
-                outstanding[rid] = eng.submit(op, A, B)
+                outstanding[rid] = eng.submit(op, A, B,
+                                              accuracy_tier=tier)
             except ValueError as e:
                 send(("result", rid, {
                     "request_id": rid, "op": op, "ok": False, "x": None,
@@ -253,9 +258,15 @@ class EngineReplica:
             "nrhs_buckets": tuple(self.cfg.nrhs_buckets),
         }
 
-    def submit(self, rid: int, op: str, A, B=None) -> None:
-        self._send(("submit", rid, op, np.asarray(A),
-                    np.asarray(B) if B is not None else None))
+    def submit(self, rid: int, op: str, A, B=None,
+               tier: str = "balanced") -> None:
+        msg = ("submit", rid, op, np.asarray(A),
+               np.asarray(B) if B is not None else None)
+        if tier != "balanced":
+            # trailing element only when non-balanced: balanced traffic
+            # keeps the pre-tier 5-tuple wire format
+            msg = msg + (tier,)
+        self._send(msg)
 
     def poll(self) -> list[tuple]:
         """Every pending outbox message (buffered ones first).  A

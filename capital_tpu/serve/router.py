@@ -73,14 +73,16 @@ class RouterTicket:
     so a replica death can re-dispatch the request — the router's no-drop
     contract is exactly this copy."""
 
-    __slots__ = ("request_id", "op", "A", "B", "t_enq", "replica_id",
-                 "attempts", "response", "_event")
+    __slots__ = ("request_id", "op", "A", "B", "tier", "t_enq",
+                 "replica_id", "attempts", "response", "_event")
 
-    def __init__(self, request_id: int, op: str, A, B):
+    def __init__(self, request_id: int, op: str, A, B,
+                 tier: str = "balanced"):
         self.request_id = request_id
         self.op = op
         self.A = A
         self.B = B
+        self.tier = tier
         self.t_enq = time.monotonic()
         self.replica_id: Optional[str] = None  # current owner
         self.attempts = 0
@@ -132,12 +134,15 @@ def _rung(ladder, v: int) -> Optional[int]:
 
 
 def bucket_signature(op: str, a_shape, b_shape, dtype: str,
-                     ladders: dict) -> tuple:
+                     ladders: dict, tier: str = "balanced") -> tuple:
     """The affinity key: the (op, padded-shape) class this request batches
     into, derived from the same ladders the engine buckets with.  Oversize
     requests key on their exact shape — each oversize shape is its own
     executable anyway, so exact-shape affinity is the cache-friendly
-    answer there too."""
+    answer there too.  The accuracy tier joins the key because tiered
+    requests compile (and batch in) their own bucket programs — affinity
+    must steer a guaranteed request to the replica whose cache holds the
+    guaranteed executable, not merely the same-shape balanced one."""
     n_r = _rung(ladders["buckets"],
                 a_shape[1] if op == "lstsq" else a_shape[0])
     k_r = (_rung(ladders["nrhs_buckets"], b_shape[1])
@@ -146,7 +151,7 @@ def bucket_signature(op: str, a_shape, b_shape, dtype: str,
     if n_r is None or m_r is None or (b_shape is not None and k_r is None):
         return ("oversize", op, str(dtype), tuple(a_shape),
                 tuple(b_shape) if b_shape is not None else None)
-    return (op, str(dtype), n_r, k_r, m_r)
+    return (op, str(dtype), n_r, k_r, m_r, str(tier))
 
 
 def _rendezvous(sig: tuple, replica_ids) -> str:
@@ -219,16 +224,22 @@ class Router:
 
     # ---- client surface ----------------------------------------------------
 
-    def submit(self, op: str, A, B=None) -> RouterTicket:
+    def submit(self, op: str, A, B=None, *,
+               accuracy_tier: str = "balanced") -> RouterTicket:
         """Dispatch one request to a healthy replica; raises RuntimeError
         when none admits (every replica dead or draining) — admission
         control, not silent queueing.  Work already admitted is never
-        subject to this: a failure re-dispatch parks instead."""
+        subject to this: a failure re-dispatch parks instead.
+
+        `accuracy_tier` rides the ticket (and the re-dispatch copy) to the
+        replica's engine.submit — tier validation is the engine's job, so
+        an invalid tier lands as a failed Result, not a router raise."""
         with self._lock:
             rid = self._next_id
             self._next_id += 1
             t = RouterTicket(rid, op, np.asarray(A),
-                             np.asarray(B) if B is not None else None)
+                             np.asarray(B) if B is not None else None,
+                             tier=accuracy_tier)
             st = self._pick(t)
             if st is None:
                 raise RuntimeError(
@@ -438,7 +449,7 @@ class Router:
         if self.cfg.policy == "bucket_affinity" and self._ladders:
             sig = bucket_signature(
                 t.op, t.A.shape, t.B.shape if t.B is not None else None,
-                t.A.dtype, self._ladders,
+                t.A.dtype, self._ladders, tier=t.tier,
             )
             rid = _rendezvous(sig, sorted(st.replica.replica_id
                                           for st in healthy))
@@ -452,7 +463,8 @@ class Router:
         removes the failed replica from the healthy set)."""
         while True:
             try:
-                st.replica.submit(t.request_id, t.op, t.A, t.B)
+                st.replica.submit(t.request_id, t.op, t.A, t.B,
+                                  tier=t.tier)
             except OSError:
                 self._fail_replica(st)
                 nxt = self._pick(t)

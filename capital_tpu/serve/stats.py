@@ -59,11 +59,30 @@ class Collector:
         # block, like latency_ms_small: absent until blocktri traffic
         # happens.
         self.blocktri_impls: Counter = Counter()
+        # accuracy_tier='guaranteed' refinement telemetry (the engine's
+        # _refine_sink feeds it per landed request).  Sweep counts are
+        # data-dependent — tracing prices exactly one sweep, so the
+        # MEASURED population here is the only place the true refinement
+        # cost is visible.  Optional block, like latency_ms_small: absent
+        # until guaranteed-tier traffic happens.
+        self.refine_iters: list[int] = []
+        self.refine_resids: list[float] = []
+        self.refine_converged = 0
+        self.refine_nonconverged = 0
 
     # ---- feeding -----------------------------------------------------------
 
     def note_blocktri_impl(self, algorithm: str) -> None:
         self.blocktri_impls[algorithm] += 1
+
+    def note_refine(self, iters: int, converged: bool,
+                    resid: float) -> None:
+        self.refine_iters.append(int(iters))
+        self.refine_resids.append(float(resid))
+        if converged:
+            self.refine_converged += 1
+        else:
+            self.refine_nonconverged += 1
 
     def note_queue_depth(self, depth: int) -> None:
         self.queue_depth_max = max(self.queue_depth_max, depth)
@@ -168,6 +187,31 @@ class Collector:
         # it means something.
         if self.blocktri_impls:
             snap["blocktri_impls"] = dict(self.blocktri_impls)
+        # guaranteed-tier refinement block: measured sweep counts and the
+        # worst landed backward error.  Iteration percentiles are COUNTS
+        # (not ms — no 1e3 scaling); resid_max is the honest aggregate of
+        # a quantity whose mean is meaningless across conditioning mixes.
+        if self.refine_iters:
+            n_ref = len(self.refine_iters)
+            snap["refine"] = {
+                "requests": n_ref,
+                "converged": self.refine_converged,
+                "nonconverged": self.refine_nonconverged,
+                "converged_frac": round(self.refine_converged / n_ref, 4),
+                "iters": {
+                    k: round(v, 4)
+                    for k, v in percentiles(
+                        [float(i) for i in self.refine_iters]).items()
+                },
+                "iters_max": max(self.refine_iters),
+                # NaN residuals (factor breakdown under the fast dtype)
+                # already count as nonconverged; keep them out of the max
+                # so it stays an orderable worst case (r == r is the
+                # NaN filter)
+                "resid_max": max(
+                    (r for r in self.refine_resids if r == r), default=0.0
+                ),
+            }
         if factor_cache and (factor_cache.get("hits", 0)
                              + factor_cache.get("misses", 0)
                              + factor_cache.get("installs", 0)) > 0:
@@ -326,4 +370,28 @@ def merge_snapshots(snaps: list[dict]) -> dict:
     if any("requests_small" in s for s in snaps):
         merged["requests_small"] = sum(int(s.get("requests_small", 0))
                                        for s in snaps)
+    # guaranteed-tier refinement: counts sum with converged_frac recomputed
+    # (never averaged); iteration percentiles take the elementwise max
+    # (they are counts, not samples — no population to pool) and resid_max
+    # the max, both honest worst-case bounds across replicas.
+    rsnaps = [s["refine"] for s in snaps if s.get("refine")]
+    if rsnaps:
+        n_ref = sum(int(r.get("requests", 0)) for r in rsnaps)
+        conv = sum(int(r.get("converged", 0)) for r in rsnaps)
+        iters = {"p50": 0.0, "p95": 0.0, "p99": 0.0}
+        for r in rsnaps:
+            for p in iters:
+                iters[p] = max(iters[p],
+                               float((r.get("iters") or {}).get(p, 0.0)))
+        merged["refine"] = {
+            "requests": n_ref,
+            "converged": conv,
+            "nonconverged": sum(int(r.get("nonconverged", 0))
+                                for r in rsnaps),
+            "converged_frac": round(conv / n_ref, 4) if n_ref else 1.0,
+            "iters": iters,
+            "iters_max": max(int(r.get("iters_max", 0)) for r in rsnaps),
+            "resid_max": max(float(r.get("resid_max", 0.0))
+                             for r in rsnaps),
+        }
     return merged

@@ -182,6 +182,20 @@ PHASE_REGISTRY: tuple[str, ...] = (
     # (blocktri_reduce_flops).  Same outside-the-scan emit rationale as
     # BT::factor.
     "BT::partition", "BT::reduce",
+    # mixed-precision iterative refinement (robust/refine.py,
+    # docs/ROBUSTNESS.md "escalation ladder").  IR::residual wraps the
+    # high-precision residual r = B − A·X (and the Aᵀr semi-normal
+    # product on the lstsq path); IR::correct wraps the correction solve
+    # against the low-precision resident factor plus the X += d update.
+    # Both scopes fire once per refine() call even though the
+    # lax.while_loop body executes a data-dependent number of times: the
+    # model prices ONE sweep and the MEASURED iteration counts land in
+    # serve request stats (stats.Collector `refine` block) — the
+    # outside-the-scan emit rationale of BT::factor.  QR::tsqr wraps the
+    # blocked Householder TSQR tree (ops/tsqr.py): leaf panel QRs, the
+    # pairwise R-stack reduction levels, and the top-down Q assembly
+    # gemms, priced whole via tsqr_flops.
+    "IR::residual", "IR::correct", "QR::tsqr",
 )
 _PHASE_SET: set[str] = set(PHASE_REGISTRY)
 
@@ -596,6 +610,36 @@ def fused_lstsq_flops(m: int, n: int, k: int) -> float:
         + 4.0 * batched_trsm_flops(n, k)
         + 2.0 * n**3
     )
+
+
+def refine_sweep_flops(n: int, k: int) -> float:
+    """ONE iterative-refinement sweep over a dense SPD solve, per problem
+    (IR::residual + IR::correct): the high-precision residual gemm
+    r = B − A·X (2n²k), the two triangular correction sweeps against the
+    resident low-precision factor, and the X += d axpy.  The while_loop
+    executes this a data-dependent number of times; the model prices one
+    sweep (see the IR::* registry note) and the measured counts live in
+    serve stats."""
+    return 2.0 * n * n * k + 2.0 * batched_trsm_flops(n, k) + 2.0 * n * k
+
+
+def refine_lstsq_sweep_flops(m: int, n: int, k: int) -> float:
+    """ONE semi-normal-equation refinement sweep over lstsq, per problem:
+    residual r = B − A·X (2mnk), gram product g = Aᵀr (2mnk), the two
+    triangular sweeps of d = R⁻¹R⁻ᵀg, and the update axpy."""
+    return 4.0 * m * n * k + 2.0 * batched_trsm_flops(n, k) + 2.0 * n * k
+
+
+def tsqr_flops(m: int, n: int, leaves: int) -> float:
+    """Blocked Householder TSQR, per problem (QR::tsqr): leaf panel QRs
+    (Householder sweep + thin-Q assembly ≈ 4·panel·n² each over `leaves`
+    panels of m/leaves rows), the pairwise (2n, n) reduction QRs
+    (leaves − 1 of them at ≈ 8n³), and the top-down per-level Q-assembly
+    gemms (2·panel·n² per leaf per level)."""
+    leaves = max(int(leaves), 1)
+    levels = max(leaves.bit_length() - 1, 0)
+    return (4.0 * m * n**2 + 8.0 * (leaves - 1) * n**3
+            + 2.0 * levels * m * n**2)
 
 
 # --------------------------------------------------------------------------

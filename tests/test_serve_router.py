@@ -97,11 +97,16 @@ class TestBucketSignature:
         lad = {"buckets": (8, 16), "rows_buckets": (32,),
                "nrhs_buckets": (1, 4)}
         assert bucket_signature("posv", (8, 8), (8, 1), "float32", lad) \
-            == ("posv", "float32", 8, 1, 0)
+            == ("posv", "float32", 8, 1, 0, "balanced")
         assert bucket_signature("lstsq", (30, 7), (30, 3), "float32", lad) \
-            == ("lstsq", "float32", 8, 4, 32)
+            == ("lstsq", "float32", 8, 4, 32, "balanced")
         assert bucket_signature("inv", (5, 5), None, "float32", lad) \
-            == ("inv", "float32", 8, None, 0)
+            == ("inv", "float32", 8, None, 0, "balanced")
+        # the accuracy tier joins the key: a guaranteed request must not
+        # share affinity with the same-shape balanced bucket
+        assert bucket_signature("posv", (8, 8), (8, 1), "float32", lad,
+                                tier="guaranteed") \
+            == ("posv", "float32", 8, 1, 0, "guaranteed")
 
     def test_oversize_keys_on_exact_shape(self):
         lad = {"buckets": (8,), "rows_buckets": (32,), "nrhs_buckets": (1,)}

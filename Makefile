@@ -6,8 +6,8 @@
 PY ?= python
 
 .PHONY: all test benchmarking bench-explicit bench-small bench-blocktri \
-	bench-blocktri-par bench-update bench-refine tune audit lint robust \
-	serve-smoke serve-bench serve-replicas native clean
+	bench-blocktri-par bench-arrowhead bench-update bench-refine tune \
+	audit lint robust serve-smoke serve-bench serve-replicas native clean
 
 all: test
 
@@ -65,6 +65,25 @@ bench-blocktri:
 	$(PY) -m capital_tpu.bench blocktri --platform cpu --dtype float32 \
 		--nblocks 8 --block 16 --batch 4 --nrhs 2 --latency --calls 8 \
 		--validate --ledger bench_blocktri.jsonl
+
+# block-arrowhead fast-path gate (docs/PERF.md round 15): the flagship
+# (nblocks=64, b=128, s=32, f32) bordered chain vs the SAME problems
+# assembled dense at n=8224, gated at >= 10x per-problem wall-clock
+# speedup — lower than bench-blocktri's 25x ON PURPOSE: the arrowhead
+# pays the widened chain solve (s extra columns every sweep) plus the
+# Schur completion on top of the chain factor, so its structural margin
+# is real but thinner.  The driver's f64-NumPy-side factor AND solve
+# residual gates are always-on (no --validate flag to forget).  The
+# second row pins the --latency protocol + the bench:arrowhead_latency
+# ledger seam on a small shape.
+bench-arrowhead:
+	rm -f bench_arrowhead.jsonl
+	$(PY) -m capital_tpu.bench arrowhead --platform cpu --dtype float32 \
+		--nblocks 64 --block 128 --border 32 --batch 1 --nrhs 1 \
+		--min-speedup 10 --ledger bench_arrowhead.jsonl
+	$(PY) -m capital_tpu.bench arrowhead --platform cpu --dtype float32 \
+		--nblocks 8 --block 16 --border 4 --batch 4 --nrhs 2 \
+		--latency --calls 8 --ledger bench_arrowhead.jsonl
 
 # parallel chain factorization gate (docs/PERF.md round 13): the
 # partitioned (Spike) blocktri driver A/B'd against the sequential scan
@@ -138,7 +157,7 @@ bench-refine:
 # The generous 0.995 bound absorbs CPU-interpret emulation; what it pins
 # is that attribution works end to end.
 audit: serve-smoke serve-bench serve-replicas bench-blocktri \
-	bench-blocktri-par bench-update bench-refine lint
+	bench-blocktri-par bench-arrowhead bench-update bench-refine lint
 	$(PY) -m capital_tpu.obs audit cholinv --n 4096 --platform cpu
 	$(PY) -m capital_tpu.obs audit cacqr --m 16384 --n 512 --platform cpu
 	$(PY) -m capital_tpu.obs robust-gate --platform cpu
@@ -238,5 +257,6 @@ clean:
 	rm -rf autotune_out .pytest_cache bench_explicit.jsonl serve_smoke.jsonl \
 		lint_report.jsonl bench_small.jsonl serve_bench.jsonl serve_cache \
 		bench_trace.jsonl serve_replicas.jsonl serve_replicas_cache \
-		bench_blocktri.jsonl bench_update.jsonl bench_refine.jsonl
+		bench_blocktri.jsonl bench_update.jsonl bench_refine.jsonl \
+		bench_arrowhead.jsonl
 	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -1,5 +1,5 @@
 """CLI: python -m capital_tpu.autotune
-{cholinv,cacqr,trsm,small,blocktri,update} [flags]."""
+{cholinv,cacqr,trsm,small,blocktri,arrowhead,update} [flags]."""
 
 from __future__ import annotations
 
@@ -11,7 +11,7 @@ import jax
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(prog="capital_tpu.autotune")
     p.add_argument("alg", choices=["cholinv", "cacqr", "trsm", "small",
-                                   "blocktri", "update"])
+                                   "blocktri", "arrowhead", "update"])
     p.add_argument("--n", type=int, default=4096)
     p.add_argument("--m", type=int, default=65536)
     p.add_argument("--dtype", default="bfloat16")
@@ -130,6 +130,10 @@ def main(argv=None) -> None:
         "(resolve_partitions snaps each to a feasible divisor of "
         "--nblocks; 0 = the √nblocks default; duplicates after snapping "
         "are deduped)",
+    )
+    p.add_argument(
+        "--border", type=int, default=8,
+        help="arrowhead: border rank s (rows of the coupling block-row)",
     )
     p.add_argument(
         "--calls", type=int, default=32,
@@ -335,6 +339,43 @@ def main(argv=None) -> None:
         res = sweep.tune_blocktri(
             grid, args.nblocks, args.block, batch=args.batch,
             nrhs=args.nrhs, dtype=dtype, out_dir=args.out,
+            occupancy=args.occupancy, calls=args.calls,
+            checkpoint=args.resume, ledger=args.ledger, **space,
+        )
+    elif args.alg == "arrowhead":
+        # latency-mode sweep for ONE posv_arrowhead bucket: impl x
+        # border blocking x scan-segment-length at fixed occupancy
+        for flag, given in (
+            ("--grids", "grids" in space),
+            ("--splits", bool(args.splits)),
+            ("--policies", bool(args.policies)),
+            ("--tail-depths", bool(args.tail_depths)),
+            ("--top-k", args.top_k != 0),
+            ("--modes", bool(args.modes)),
+            ("--bc", bool(args.bc)),
+            ("--buckets", bool(args.buckets)),
+        ):
+            if given:
+                p.error(
+                    f"{flag} is not an arrowhead sweep axis (impl x block "
+                    "x seg only)"
+                )
+        space = {}
+        if args.impls:
+            if any(i in ("vmap", "pallas_split") for i in args.impls):
+                p.error("arrowhead impls are 'xla', 'pallas' and "
+                        "'partitioned' only")
+            space["impls"] = tuple(args.impls)
+        if args.blocks:
+            space["blocks"] = tuple(args.blocks)
+        if args.segs:
+            space["segs"] = tuple(args.segs)
+        if args.partitions:
+            space["partitions"] = tuple(args.partitions)
+        grid = Grid.square(c=1, devices=dev[:1])
+        res = sweep.tune_arrowhead(
+            grid, args.nblocks, args.block, border=args.border,
+            batch=args.batch, nrhs=args.nrhs, dtype=dtype, out_dir=args.out,
             occupancy=args.occupancy, calls=args.calls,
             checkpoint=args.resume, ledger=args.ledger, **space,
         )

@@ -886,6 +886,156 @@ def tune_blocktri(
     )
 
 
+def arrowhead_space(
+    nblocks: int,
+    b: int,
+    tail,
+    dtype,
+    impls: Iterable[str] = ("xla", "pallas"),
+    blocks: Iterable[int] = (0,),
+    segs: Iterable[int] = (1, 4, 8),
+    partitions: Iterable[int] = (0,),
+):
+    """impl x border-column blocking x scan-segment-length for the
+    block-arrowhead solve (models/arrowhead): the chain knobs of
+    blocktri_space applied to the WIDENED chain solve that carries the
+    border columns alongside the RHS.  `block` is the border-blocking
+    knob — the batched_small in-kernel column unroll tiles the s + nrhs
+    solve columns, so it decides how the border block-row is chunked
+    through the chain sweep; `seg` amortizes pallas_call launches exactly
+    as in blocktri_space; the xla impl contributes one baseline config
+    and 'partitioned' sweeps the partitions x block plane (seg is not an
+    axis there).  `tail` = (F, S, B_rhs, Bs) rides as a closure so the
+    swept operand stays the single packed chain array
+    (batch, 2, nblocks, b, b) — the serve bucket packing of the chain
+    half, like blocktri_space."""
+    from capital_tpu.models import arrowhead, blocktri
+    from capital_tpu.ops import batched_small
+
+    F, S, B_rhs, Bs = tail
+    prec = None if jnp.dtype(dtype).itemsize < 4 else "highest"
+    for impl in impls:
+        if impl not in ("xla", "pallas", "partitioned"):
+            raise ValueError(
+                "arrowhead_space: impl must be 'xla', 'pallas' or "
+                f"'partitioned', got {impl!r}"
+            )
+        if impl == "xla":
+            def step(a):
+                return arrowhead.posv(a[:, 0], a[:, 1], F, S, B_rhs, Bs,
+                                      precision=prec, impl="xla")
+
+            yield "xla", {"impl": "xla"}, step
+            continue
+        if impl == "partitioned":
+            seen_p = set()
+            for part in partitions:
+                p_eff = blocktri.resolve_partitions(nblocks, part)
+                for blk in blocks:
+                    blk_eff = blk or batched_small.pick_block(b)
+                    if (p_eff, blk_eff) in seen_p:
+                        continue
+                    seen_p.add((p_eff, blk_eff))
+
+                    def step(a, blk=blk, part=p_eff):
+                        return arrowhead.posv(
+                            a[:, 0], a[:, 1], F, S, B_rhs, Bs, block=blk,
+                            precision=prec, impl="partitioned",
+                            partitions=part)
+
+                    yield (
+                        f"part_p{p_eff}_b{blk_eff}",
+                        {"impl": "partitioned", "partitions": p_eff,
+                         "block": blk_eff},
+                        step,
+                    )
+            continue
+        for blk in blocks:
+            blk_eff = blk or batched_small.pick_block(b)
+            for seg in segs:
+                seg_eff = blocktri.resolve_seg(nblocks, seg)
+
+                def step(a, blk=blk, seg=seg_eff):
+                    return arrowhead.posv(
+                        a[:, 0], a[:, 1], F, S, B_rhs, Bs, block=blk,
+                        seg=seg, precision=prec, impl="pallas")
+
+                yield (
+                    f"pallas_b{blk_eff}_s{seg_eff}",
+                    {"impl": "pallas", "block": blk_eff, "seg": seg_eff},
+                    step,
+                )
+
+
+def tune_arrowhead(
+    grid: Grid,
+    nblocks: int,
+    b: int,
+    border: int = 8,
+    batch: int = 8,
+    nrhs: int = 1,
+    dtype=jnp.float32,
+    out_dir: str = "autotune_out",
+    occupancy: float = 1.0,
+    calls: int = 32,
+    warmup: int = 3,
+    checkpoint: bool = False,
+    ledger: str | None = None,
+    **space,
+) -> list[SweepResult]:
+    """Latency-mode sweep for ONE posv_arrowhead serve bucket: impl x
+    border blocking x scan-segment-length measured by per-call p99 wall
+    time at fixed batch occupancy — tune_blocktri's objective, on the
+    bordered op.  The operand batch carries ``round(occupancy * batch)``
+    real arrowheads and identity fill for the tail (identity chain +
+    identity corner + zero border/RHS — exactly batching.fill_problem)."""
+    import numpy as np
+
+    if not 0.0 < occupancy <= 1.0:
+        raise ValueError(
+            f"tune_arrowhead: occupancy {occupancy} outside (0, 1]")
+    real = max(1, round(occupancy * batch))
+    rng = np.random.default_rng(4)
+    G = rng.standard_normal((batch, nblocks, b, b))
+    D = G @ G.transpose(0, 1, 3, 2) / b + 3.0 * np.eye(b)
+    C = 0.3 / np.sqrt(b) * rng.standard_normal((batch, nblocks, b, b))
+    C[:, 0] = 0.0
+    # border coupling shrinks with chain length: it touches every chain
+    # block, so its Schur correction grows with nblocks·b and a fixed
+    # scale would push the corner indefinite at long chains
+    F = 0.3 / np.sqrt(nblocks * b) * rng.standard_normal(
+        (batch, nblocks, border, b))
+    S0 = rng.standard_normal((batch, border, border))
+    S = S0 @ S0.transpose(0, 2, 1) / border + 5.0 * np.eye(border)
+    B = rng.standard_normal((batch, nblocks, b, nrhs))
+    Bs = rng.standard_normal((batch, border, nrhs))
+    D[real:] = np.eye(b)
+    C[real:] = 0.0
+    F[real:] = 0.0
+    S[real:] = np.eye(border)
+    B[real:] = 0.0  # fill problems: zero RHS -> exact-zero solutions
+    Bs[real:] = 0.0
+    A = jax.block_until_ready(jnp.asarray(np.stack([D, C], axis=1), dtype))
+    tail = tuple(
+        jax.block_until_ready(jnp.asarray(t, dtype)) for t in (F, S, B, Bs)
+    )
+    return run_sweep(
+        "arrowhead",
+        arrowhead_space(nblocks, b, tail, dtype, **space),
+        A,
+        out_dir,
+        dtype=dtype,
+        checkpoint=checkpoint,
+        key_extra={
+            **_grid_key(grid), "op": "posv_arrowhead", "nblocks": nblocks,
+            "b": b, "border": border, "batch": batch, "nrhs": nrhs,
+            "occupancy": occupancy, "calls": calls,
+        },
+        ledger=ledger,
+        measure=latency_measure(calls=calls, warmup=warmup),
+    )
+
+
 def update_small_space(
     n: int,
     k: int,

@@ -1178,6 +1178,257 @@ def blocktri(args) -> dict:
     return rec
 
 
+def _arrowhead_batch(nblocks: int, b: int, s: int, batch: int, nrhs: int,
+                     dtype, seed: int = 5):
+    """One batch of SPD block-arrowhead systems (the serve posv_arrowhead
+    geometry): the _blocktri_batch chain family plus a thin border at
+    0.3/√(nblocks·b)·randn — the border couples EVERY chain block, so the
+    Schur correction F·T⁻¹·Fᵀ grows with chain length and the coupling
+    must shrink with it or the corner S = S₀·S₀ᵀ/s + 5I goes indefinite
+    at flagship n (the whole matrix stops being SPD, not a solver bug).
+    Returns device arrays plus the f64 numpy masters."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    G = rng.standard_normal((batch, nblocks, b, b))
+    D = G @ G.transpose(0, 1, 3, 2) / b + 3.0 * np.eye(b)
+    C = 0.3 / np.sqrt(b) * rng.standard_normal((batch, nblocks, b, b))
+    C[:, 0] = 0.0
+    F = 0.3 / np.sqrt(nblocks * b) * rng.standard_normal(
+        (batch, nblocks, s, b))
+    S0 = rng.standard_normal((batch, s, s))
+    S = S0 @ S0.transpose(0, 2, 1) / s + 5.0 * np.eye(s)
+    B = rng.standard_normal((batch, nblocks, b, nrhs))
+    Bs = rng.standard_normal((batch, s, nrhs))
+    dev = tuple(
+        jax.block_until_ready(jnp.asarray(x, dtype))
+        for x in (D, C, F, S, B, Bs)
+    )
+    return dev, (D, C, F, S, B, Bs)
+
+
+def _arrowhead_chain_solve_np(D, C, R):
+    """f64 NumPy block-Cholesky chain solve (batch, nblocks, b, r) — an
+    independent reference implementation (LAPACK via numpy/scipy, never
+    models/blocktri) so the residual gates compare the code under test
+    against something it cannot share a bug with."""
+    import numpy as np
+    from scipy.linalg import solve_triangular
+
+    batch, nblocks, b, _ = D.shape
+    L = np.zeros_like(D)
+    W = np.zeros_like(C)
+    Y = np.zeros_like(R)
+    for z in range(batch):
+        for i in range(nblocks):
+            Di = D[z, i].copy()
+            if i:
+                # W_i = C_i · L_{i−1}⁻ᵀ  (solve L·Xᵀ = C_iᵀ, transpose)
+                W[z, i] = solve_triangular(
+                    L[z, i - 1], C[z, i].T, lower=True).T
+                Di -= W[z, i] @ W[z, i].T
+            L[z, i] = np.linalg.cholesky(Di)
+            rhs = R[z, i] - (W[z, i] @ Y[z, i - 1] if i else 0.0)
+            Y[z, i] = solve_triangular(L[z, i], rhs, lower=True)
+        for i in range(nblocks - 1, -1, -1):
+            rhs = Y[z, i].copy()
+            if i + 1 < nblocks:
+                rhs -= W[z, i + 1].T @ Y[z, i + 1]
+            Y[z, i] = solve_triangular(L[z, i], rhs, lower=True, trans="T")
+    return Y
+
+
+def _arrowhead_dense(D, C, F, S):
+    """Assemble the f64 numpy arrowhead masters to dense (batch, n, n) —
+    NumPy-side for the same reason as _blocktri_dense (the reference must
+    never touch models/arrowhead.assemble, itself new this round)."""
+    import numpy as np
+
+    A = _blocktri_dense(D, C)
+    batch, nblocks, s, b = F.shape
+    n_t = nblocks * b
+    Bd = F.transpose(0, 2, 1, 3).reshape(batch, s, n_t)
+    top = np.concatenate([A, Bd.transpose(0, 2, 1)], axis=2)
+    bottom = np.concatenate([Bd, S], axis=2)
+    return np.concatenate([top, bottom], axis=1)
+
+
+def arrowhead(args) -> dict:
+    """Bench the block-arrowhead fast path (models/arrowhead.posv) and
+    measure its wall-clock speedup against the equal-n dense batched posv
+    on the SAME problems assembled dense — the structural
+    O(nblocks·b³ + nblocks·b²·s + s³) vs O((nblocks·b + s)³) win the
+    round-15 flagship gate pins (docs/PERF.md).  Unlike the blocktri
+    driver this one ALWAYS runs its f64 residual gates — both halves of
+    the factorization are new (the widened chain solve and the Schur
+    completion), so a speedup row must prove its answers every run:
+    the solve gate is the whole-matrix backward error computed blockwise
+    in f64 (no densification needed), the factor gate reconstructs
+    L_S·L_Sᵀ against a Schur complement built from an independent NumPy
+    block-Cholesky chain solve."""
+    from capital_tpu.models import arrowhead as ah_mod
+    from capital_tpu.models import blocktri as bt_mod
+    from capital_tpu.serve import api
+
+    import numpy as np
+
+    dtype = jnp.dtype(args.dtype)
+    grid = Grid.square(c=1, devices=jax.devices()[:1])
+    prec = _precision(args, dtype)
+    nblocks, b, s = args.nblocks, args.block, args.border
+    batch, nrhs = args.batch, args.nrhs
+    n_t = nblocks * b
+    n = n_t + s
+    impl = args.impl
+    if impl == "auto" and jax.default_backend() != "tpu":
+        # the blocktri driver's off-TPU honest-wall pin, same rationale
+        impl = "xla"
+    (Dj, Cj, Fj, Sj, Bj, Bsj), (Dn, Cn, Fn, Sn, Bn, Bsn) = _arrowhead_batch(
+        nblocks, b, s, batch, nrhs, dtype)
+    partitions = 0
+    if impl == "partitioned":
+        partitions = bt_mod.resolve_partitions(nblocks, args.partitions)
+        inner = "xla" if jax.default_backend() != "tpu" else "auto"
+        fn = jax.jit(
+            lambda d, c, f, sc, rhs, bs: ah_mod.posv(
+                d, c, f, sc, rhs, bs, precision=prec, impl="partitioned",
+                partitions=partitions, partition_inner=inner,
+            )
+        )
+    else:
+        fn = jax.jit(
+            lambda d, c, f, sc, rhs, bs: ah_mod.posv(
+                d, c, f, sc, rhs, bs, precision=prec, impl=impl)
+        )
+
+    # --- residual gates (always on; see the docstring) ---
+    X, Xs, info = jax.block_until_ready(fn(Dj, Cj, Fj, Sj, Bj, Bsj))
+    bad = int(jnp.sum(info != 0))
+    if bad:
+        sys.exit(f"validation failed: {bad} problem(s) report info != 0")
+    tol = _tolerance(dtype)
+    Xn = np.asarray(X, np.float64)
+    Xsn = np.asarray(Xs, np.float64)
+    # blockwise residual: chain rows D_i·x_i + C_i·x_{i−1} + C_{i+1}ᵀ·x_{i+1}
+    # + F_iᵀ·x_s − b_i, corner rows Σ F_i·x_i + S·x_s − b_s
+    Rc = np.einsum("znab,znbk->znak", Dn, Xn) - Bn
+    Rc[:, 1:] += np.einsum("znab,znbk->znak", Cn[:, 1:], Xn[:, :-1])
+    Rc[:, :-1] += np.einsum("znba,znbk->znak", Cn[:, 1:], Xn[:, 1:])
+    Rc += np.einsum("znsb,zsk->znbk", Fn, Xsn)
+    Rs = np.einsum("znsb,znbk->zsk", Fn, Xn) + Sn @ Xsn - Bsn
+    rhs_n = np.concatenate([Bn.reshape(batch, n_t, nrhs), Bsn], axis=1)
+    res = np.concatenate([Rc.reshape(batch, n_t, nrhs), Rs], axis=1)
+    solve_resid = max(
+        float(np.linalg.norm(res[i]) / np.linalg.norm(rhs_n[i]))
+        for i in range(batch)
+    )
+    _gate("arrowhead_solve_residual", solve_resid, tol)
+    # factor gate: L_S·L_Sᵀ vs the f64 reference Schur complement
+    # S̃ = S − F·(T⁻¹·Fᵀ) built from the independent NumPy chain solve
+    Zb_ref = _arrowhead_chain_solve_np(Dn, Cn, Fn.transpose(0, 1, 3, 2))
+    St_ref = Sn - np.einsum("znsb,znbt->zst", Fn, Zb_ref)
+    _, _, Ls, _ = jax.block_until_ready(
+        jax.jit(lambda d, c, f, sc: ah_mod.schur(
+            d, c, f, sc, precision=prec,
+            impl="xla" if impl == "partitioned" else impl,
+        ))(Dj, Cj, Fj, Sj)
+    )
+    Lsn = np.asarray(Ls, np.float64)
+    factor_resid = max(
+        float(np.linalg.norm(Lsn[i] @ Lsn[i].T - St_ref[i])
+              / np.linalg.norm(St_ref[i]))
+        for i in range(batch)
+    )
+    _gate("arrowhead_factor_residual", factor_resid, tol)
+
+    # useful flops per system: the widened chain solve (s + nrhs columns
+    # through the blocktri count) + the AH::schur / AH::border phases
+    flops = batch * (
+        nblocks * (b**3 / 3.0 + 3.0 * b**3 + 6.0 * b * b * (s + nrhs))
+        + 2.0 * n_t * s * s + s**3 / 3.0
+        + 4.0 * n_t * s * nrhs + 2.0 * s * s * nrhs
+    )
+
+    if args.latency:
+        samples = harness.latency_samples(
+            lambda: fn(Dj, Cj, Fj, Sj, Bj, Bsj), calls=args.calls, warmup=3
+        )
+        pcts = harness.percentiles(samples)
+        from capital_tpu.obs.ledger import SCHEMA_VERSION
+
+        rec = {
+            "metric": "arrowhead_latency",
+            "schema_version": SCHEMA_VERSION,
+            "value": round(1.0 / pcts["p99"], 3),
+            "unit": "batch/s",
+            "seconds": pcts["p99"],
+            "wall_ms": {k: round(v * 1e3, 4) for k, v in pcts.items()},
+            "dtype": str(dtype),
+            "device": jax.devices()[0].device_kind,
+            "platform": jax.default_backend(),
+            "nblocks": nblocks, "block": b, "border": s, "n": n,
+            "batch": batch, "nrhs": nrhs, "impl": impl, "calls": args.calls,
+        }
+        import json as _json
+
+        print(_json.dumps(rec))
+        _ledger_append(args, rec, name="arrowhead_latency", grid=grid,
+                       dtype=dtype,
+                       cfg={"op": "posv_arrowhead", "impl": impl})
+        return rec
+
+    samples = harness.latency_samples(
+        lambda: fn(Dj, Cj, Fj, Sj, Bj, Bsj), calls=max(args.iters, 3),
+        warmup=3
+    )
+    t = sum(samples) / len(samples)
+
+    # dense comparison on the same problems, per-problem amortized both
+    # sides, batch shrunk when batch·n² won't fit (the blocktri policy)
+    dense_batch = batch
+    dense_bytes = batch * n * n * dtype.itemsize
+    if dense_bytes > 2e9:
+        dense_batch = max(1, int(2e9 // (n * n * dtype.itemsize)))
+    Adj = jax.block_until_ready(jnp.asarray(
+        _arrowhead_dense(Dn[:dense_batch], Cn[:dense_batch],
+                         Fn[:dense_batch], Sn[:dense_batch]), dtype))
+    Bdj = jax.block_until_ready(
+        jnp.asarray(rhs_n[:dense_batch], dtype))
+    dense_fn = jax.jit(api.batched("posv", prec, args.small_impl))
+    dsamples = harness.latency_samples(
+        lambda: dense_fn(Adj, Bdj), calls=max(args.iters, 3), warmup=1
+    )
+    t_dense = sum(dsamples) / len(dsamples)
+    speedup = (t_dense / dense_batch) / (t / batch)
+    print(f"# speedup {speedup:.1f}x vs dense posv n={n} "
+          f"(dense {t_dense / dense_batch * 1e3:.1f} ms/problem, "
+          f"arrowhead {t / batch * 1e3:.3f} ms/problem)")
+
+    rec = harness.report(
+        "arrowhead_tflops", t, flops, dtype, nblocks=nblocks, block=b,
+        border=s, n=n, batch=batch, nrhs=nrhs, impl=impl, grid=repr(grid),
+        speedup=round(speedup, 2),
+        arrow_ms=round(t / batch * 1e3, 4),
+        dense_ms=round(t_dense / dense_batch * 1e3, 3),
+        factor_resid=factor_resid, solve_resid=solve_resid,
+        wall_ms={k: round(v * 1e3, 4)
+                 for k, v in harness.percentiles(samples).items()},
+        **({"partitions": partitions} if impl == "partitioned" else {}),
+    )
+    if args.min_speedup and speedup < args.min_speedup:
+        _ledger_append(args, rec, name="arrowhead", grid=grid, dtype=dtype,
+                       cfg={"op": "posv_arrowhead", "impl": impl,
+                            "nblocks": nblocks, "block": b, "border": s})
+        sys.exit(
+            f"speedup gate failed: {speedup:.1f}x < {args.min_speedup}x "
+            f"vs dense posv at n={n}"
+        )
+    _ledger_append(args, rec, name="arrowhead", grid=grid, dtype=dtype,
+                   cfg={"op": "posv_arrowhead", "impl": impl,
+                        "nblocks": nblocks, "block": b, "border": s})
+    return rec
+
+
 def update(args) -> dict:
     """Bench online factor maintenance (ops/update_small): measured rank-k
     chol_update against the REFACTOR-FROM-RESIDENT-STATE baseline — the
@@ -1631,6 +1882,7 @@ DRIVERS = {
     "posv": posv,
     "lstsq": lstsq,
     "blocktri": blocktri,
+    "arrowhead": arrowhead,
     "update": update,
     "refine": refine,
 }
@@ -1742,6 +1994,11 @@ def build_parser() -> argparse.ArgumentParser:
         "n = nblocks * block)",
     )
     p.add_argument(
+        "--border", type=int, default=32,
+        help="arrowhead: border rank s (rows of the coupling block-row "
+        "and the dense corner; n = nblocks * block + border)",
+    )
+    p.add_argument(
         "--impl", default="auto",
         choices=["auto", "pallas", "xla", "partitioned"],
         help="blocktri: chain implementation; auto = pallas scan on TPU, "
@@ -1765,9 +2022,11 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--min-speedup", type=float, default=0.0,
-        help="blocktri: fail the run when the measured per-problem "
-        "speedup vs equal-n dense posv lands below this factor "
-        "(the round-11 flagship gate: 25 at nblocks=64, block=128, f32); "
+        help="blocktri/arrowhead: fail the run when the measured "
+        "per-problem speedup vs equal-n dense posv lands below this "
+        "factor (the round-11 flagship gate: 25 at nblocks=64, block=128, "
+        "f32; the round-15 arrowhead gate: 10 at nblocks=64, block=128, "
+        "border=32, f32); "
         "refine: the same flag gates the FACTOR-PHASE narrow-vs-wide "
         "potrf speedup (the round-14 gate: 1.5 at n=1024 f64 on the CPU "
         "rig — end-to-end latency is reported ungated, see the driver "

@@ -22,7 +22,7 @@ from capital_tpu.lint.program import ProgramTarget
 
 TARGET_NAMES = ("cholinv", "cacqr", "serve", "batched_small", "serve_sched",
                 "cholinv_fused", "blocktri", "blocktri_partitioned",
-                "update_small", "refine")
+                "arrowhead", "update_small", "refine")
 
 
 def _grid():
@@ -200,6 +200,39 @@ def blocktri_partitioned_target(
     )
 
 
+def arrowhead_target(
+    nblocks: int = 4, b: int = 16, s: int = 4, nrhs: int = 2,
+    capacity: int = 4, dtype=jnp.float32,
+) -> ProgramTarget:
+    """The serve posv_arrowhead bucket program (models/arrowhead through
+    api.batched, the executable engine._get_batched compiles): the
+    widened chain solve rides blocktri's ``BT::factor`` / ``BT::solve``
+    scans unchanged, the Schur completion + corner factor lands under
+    ``AH::schur`` and the border back-substitution under ``AH::border``
+    — all four phase tags under the phase-coverage rule, and the packed
+    operand unpack under cache-key hygiene (geometry comes from static
+    shapes, never from traced values).  Forced impl='pallas' so the
+    chain scans ride the kernel route serve routes on TPU regardless of
+    the CPU rig's default_impl answer.  ``flops_audited=False``: the
+    chain half executes inside interpreted ``pallas_call`` scan bodies
+    on the CPU rig, invisible to XLA ``cost_analysis`` — the AH::*
+    einsums alone would always undershoot the whole-program envelope
+    (same reasoning as blocktri_target).  No donation — the engine
+    donates nothing for posv_arrowhead: the packed (n_T + s, s + nrhs)
+    tail feeds BOTH solve outputs (chain X and corner Xs), so neither
+    output can safely alias it."""
+    from capital_tpu.serve import api
+
+    dt = jnp.dtype(dtype)
+    a_sds = jax.ShapeDtypeStruct((capacity, 2, nblocks, b, b), dt)
+    b_sds = jax.ShapeDtypeStruct((capacity, nblocks * b + s, s + nrhs), dt)
+    return ProgramTarget(
+        name=f"serve-arrowhead-b{capacity}-nb{nblocks}-bs{b}-s{s}",
+        fn=api.batched("posv_arrowhead", impl="pallas"),
+        args=(a_sds, b_sds), flops_audited=False,
+    )
+
+
 def update_small_target(
     n: int = 64, k: int = 4, capacity: int = 8, dtype=jnp.float32,
 ) -> ProgramTarget:
@@ -364,6 +397,8 @@ def flagship_targets(names=None) -> list[ProgramTarget]:
             out.append(blocktri_target())
         elif name == "blocktri_partitioned":
             out.append(blocktri_partitioned_target())
+        elif name == "arrowhead":
+            out.append(arrowhead_target())
         elif name == "update_small":
             out.append(update_small_target())
         elif name == "refine":

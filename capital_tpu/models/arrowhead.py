@@ -1,0 +1,252 @@
+"""Block-arrowhead Cholesky: a blocktri chain plus a low-rank border.
+
+The shape (ROADMAP item 2(b) — the constrained-least-squares and
+Kalman-with-global-state workload) is an SPD matrix
+
+        A = [[T, Bᵀ],
+             [B, S ]]
+
+where T is a block-tridiagonal SPD chain (nblocks blocks of size b,
+n_T = nblocks·b), B is a THIN border (s rows, s ≪ n_T) coupling every
+chain block to a small dense corner S (s × s).  Factoring A dense costs
+O((n_T + s)³); riding the chain structure costs
+
+        O(nblocks·b³  +  nblocks·b²·s  +  s³)
+          chain factor   border solves   corner chol
+
+— the same structural win `models/blocktri` proved for the pure chain
+(PERF.md rounds 10/13), extended by a Schur-complement completion:
+
+        T = L̃·L̃ᵀ                      (blocktri factor, UNCHANGED)
+        Z_B = T⁻¹·Bᵀ                  (border columns through the chain)
+        S̃  = S − B·Z_B                (Schur complement of T in A)
+        S̃  = L_S·L_Sᵀ                 (one dense s×s Cholesky)
+
+and the solve A·[x_T; x_S] = [b_T; b_S] completes as
+
+        Z_r = T⁻¹·b_T
+        y   = b_S − B·Z_r
+        x_S = L_S⁻ᵀ·(L_S⁻¹·y)
+        x_T = Z_r − Z_B·x_S.
+
+**One widened chain solve.**  Z_r and Z_B come out of a SINGLE
+`blocktri.posv` call on the widened RHS [b_T | Bᵀ] (k + s columns).
+That is deliberate: `posv` is the only blocktri entry point the
+partitioned (Spike) driver serves — `factor`/`solve` are sequential-scan
+only — so solving the border columns through `posv` is what lets the
+whole arrowhead ride `impl='partitioned'` unchanged (the acceptance
+criterion "partitioned-chain path works under the border solve").  The
+chain work prices itself under blocktri's own BT::* phases at the
+widened k + s column count; only the completion the arrowhead ADDS is
+priced here, under AH::schur (border gemm + corner chol) and AH::border
+(corner RHS correction, corner triangular solves, chain
+back-substitution) — see tracing.arrowhead_schur_flops /
+arrowhead_border_flops.
+
+**Breakdown coordinates.**  The chain factor reports a LAPACK potrf
+status over n_T (blocktri's per-block min-combine); the corner Cholesky
+is checked post-hoc by `robust/detect.factor_info` over s.  Both fold
+through `detect.combine_block_infos` with the corner window at diagonal
+offset n_T, so a returned info = k is 1-based in WHOLE-MATRIX
+coordinates: k ≤ n_T is a chain pivot, n_T < k ≤ n_T + s is a corner
+pivot (the Schur complement went indefinite — T healthy but A not SPD),
+and n_T + s + 1 is the off-diagonal-NaN sentinel.  A chain breakdown
+NaN-poisons Z_B and hence S̃, so the corner window also flags — the
+min-combine's pivot precedence keeps the EARLIER chain pivot
+(docs/ROBUSTNESS.md "Corner pivots in whole-matrix coordinates").
+
+**Serve packing.**  `posv_arrowhead` (serve/batching.py) carries the
+chain as the posv_blocktri pack A = (2, nblocks, b, b) and everything
+else — border, corner, RHS — as ONE (n_T + s, s + k) tail operand:
+column block [:s] is the dense system's last s columns [Bᵀ; S], column
+block [s:] is the full RHS [b_T; b_S].  `pack`/`unpack` are that
+layout's host/trace-side codecs; geometry (nblocks, b, s, k) reads back
+from static shapes alone, so bucket resolution never touches values.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from capital_tpu.models import blocktri
+from capital_tpu.robust import detect
+from capital_tpu.utils import tracing
+
+
+def _check_arrowhead(D, C, F, S, B=None, Bs=None, op="arrowhead"):
+    """Shape-validate the arrowhead operand family (the chain pair D/C is
+    re-checked by blocktri itself; this layer owns the border/corner)."""
+    if D.ndim != 4 or D.shape[-1] != D.shape[-2]:
+        raise ValueError(
+            f"{op}: D must be (batch, nblocks, b, b), got {D.shape}")
+    batch, nblocks, b, _ = D.shape
+    if F.ndim != 4 or F.shape[:2] != (batch, nblocks) or F.shape[-1] != b:
+        raise ValueError(
+            f"{op}: F must be (batch, nblocks, s, b) riding D {D.shape}, "
+            f"got {F.shape}")
+    s = F.shape[2]
+    if s < 1:
+        raise ValueError(f"{op}: border must have s >= 1 rows, got s={s}")
+    if S.shape != (batch, s, s):
+        raise ValueError(
+            f"{op}: S must be (batch, s, s) = ({batch}, {s}, {s}) riding "
+            f"F {F.shape}, got {S.shape}")
+    if B is not None:
+        if B.ndim != 4 or B.shape[:3] != (batch, nblocks, b):
+            raise ValueError(
+                f"{op}: B must be (batch, nblocks, b, k) riding D "
+                f"{D.shape}, got {B.shape}")
+        if Bs.shape != (batch, s, B.shape[-1]):
+            raise ValueError(
+                f"{op}: Bs must be (batch, s, k) = ({batch}, {s}, "
+                f"{B.shape[-1]}) riding B {B.shape}, got {Bs.shape}")
+
+
+def _combine_info(chain_info, corner_info, nblocks: int, b: int, s: int):
+    """Fold the chain's global status (over n_T, sentinel n_T + 1) and the
+    corner's local status (over s) into one whole-matrix potrf status.
+    Feeding the chain info as a (0, n_T) window is exact: local w in
+    [1, n_T] maps to itself and the w == n_T + 1 sentinel maps to the
+    global n + 1 sentinel (combine_block_infos' nw + 1 rule)."""
+    n_t = nblocks * b
+    start = jnp.zeros(chain_info.shape, jnp.int32)
+    return detect.combine_block_infos(
+        start, [(0, n_t, chain_info), (n_t, s, corner_info)], n_t + s)
+
+
+def _corner_factor(F, Zb, S, precision):
+    """AH::schur — assemble S̃ = S − B·Z_B by one batched gemm reduction
+    over the chain blocks and factor it dense.  `lax.linalg.cholesky`
+    reads only the lower triangle, so the numerically-unsymmetric upper
+    half of S̃ never feeds the factor."""
+    batch, nblocks, s, b = F.shape
+    with tracing.scope("AH::schur"):
+        tracing.emit(
+            flops=batch * tracing.arrowhead_schur_flops(nblocks, b, s))
+        stilde = S - jnp.einsum("znsb,znbt->zst", F, Zb,
+                                precision=precision)
+        ls = jnp.linalg.cholesky(stilde)
+        corner_info = jax.vmap(detect.factor_info)(ls)
+    return stilde, ls, corner_info
+
+
+def posv(D, C, F, S, B, Bs, *, block: int = 0, seg: int = 0,
+         precision: str | None = "highest", impl: str = "auto",
+         interpret: bool | None = None, partitions: int = 0,
+         partition_inner: str = "auto"):
+    """Factor-and-solve the block-arrowhead system A·[x_T; x_S] = [B; Bs].
+
+    Operands:
+      D, C — the chain's (batch, nblocks, b, b) diagonal / sub-diagonal
+             blocks, exactly blocktri.posv's contract (C[:, 0] ignored);
+      F    — the border, (batch, nblocks, s, b): F[:, i] couples chain
+             block i to the corner (the dense border is their horizontal
+             concatenation, s × n_T);
+      S    — the (batch, s, s) dense SPD corner;
+      B    — the chain RHS, (batch, nblocks, b, k) (blocked like D);
+      Bs   — the corner RHS, (batch, s, k).
+
+    `impl` / `partitions` / `partition_inner` pass straight through to
+    the ONE widened blocktri.posv call (module docstring) — sequential
+    scan and the partitioned Spike driver both serve the border columns.
+
+    Returns (X, Xs, info): X (batch, nblocks, b, k) chain solution
+    blocked like B, Xs (batch, s, k) corner solution, info (batch,)
+    int32 whole-matrix potrf status over n = nblocks·b + s (module
+    docstring "Breakdown coordinates")."""
+    _check_arrowhead(D, C, F, S, B, Bs, op="arrowhead posv")
+    batch, nblocks, b, _ = D.shape
+    s, k = F.shape[2], B.shape[-1]
+    # one widened chain solve: [Z_r | Z_B] = T⁻¹·[B | Bᵀ]
+    ft = jnp.swapaxes(F, -1, -2)  # (batch, nblocks, b, s)
+    z, chain_info = blocktri.posv(
+        D, C, jnp.concatenate([B, ft], axis=-1), block=block, seg=seg,
+        precision=precision, impl=impl, interpret=interpret,
+        partitions=partitions, partition_inner=partition_inner)
+    zr, zb = z[..., :k], z[..., k:]
+    _, ls, corner_info = _corner_factor(F, zb, S, precision)
+    with tracing.scope("AH::border"):
+        tracing.emit(
+            flops=batch * tracing.arrowhead_border_flops(nblocks, b, s, k))
+        # corner RHS correction y = b_S − B·Z_r, the two (s, s) triangular
+        # corner solves, and the chain back-substitution X = Z_r − Z_B·X_s
+        t1 = Bs - jnp.einsum("znsb,znbk->zsk", F, zr, precision=precision)
+        t2 = lax.linalg.triangular_solve(ls, t1, left_side=True, lower=True)
+        xs = lax.linalg.triangular_solve(ls, t2, left_side=True, lower=True,
+                                         transpose_a=True)
+        x = zr - jnp.einsum("znbs,zsk->znbk", zb, xs, precision=precision)
+    return x, xs, _combine_info(chain_info, corner_info, nblocks, b, s)
+
+
+def schur(D, C, F, S, *, block: int = 0, seg: int = 0,
+          precision: str | None = "highest", impl: str = "auto",
+          interpret: bool | None = None, partitions: int = 0,
+          partition_inner: str = "auto"):
+    """The completion HALF of the factorization, exposed for audits and
+    benches: border solve Z_B = T⁻¹·Bᵀ, Schur complement
+    S̃ = S − B·Z_B, and its dense Cholesky L_S.
+
+    Returns (Zb, Stilde, Ls, info): Zb (batch, nblocks, b, s) blocked
+    like the chain, Stilde/Ls (batch, s, s), info (batch,) in
+    whole-matrix coordinates like `posv` (the chain status comes from
+    the border solve's factor).  `make bench-arrowhead` gates
+    ‖L_S·L_Sᵀ − S̃‖ against an f64 NumPy Schur reference through this
+    entry point."""
+    _check_arrowhead(D, C, F, S, op="arrowhead schur")
+    batch, nblocks, b, _ = D.shape
+    s = F.shape[2]
+    zb, chain_info = blocktri.posv(
+        D, C, jnp.swapaxes(F, -1, -2), block=block, seg=seg,
+        precision=precision, impl=impl, interpret=interpret,
+        partitions=partitions, partition_inner=partition_inner)
+    stilde, ls, corner_info = _corner_factor(F, zb, S, precision)
+    return zb, stilde, ls, _combine_info(chain_info, corner_info,
+                                         nblocks, b, s)
+
+
+def assemble(D, C, F, S):
+    """Materialize the dense (batch, n, n) arrowhead, n = nblocks·b + s —
+    test/bench reference only (the point of the module is to never build
+    this on the serve path)."""
+    _check_arrowhead(D, C, F, S, op="arrowhead assemble")
+    batch, nblocks, _, b = D.shape
+    s = F.shape[2]
+    td = blocktri.assemble(D, C)
+    # border rows: (batch, nblocks, s, b) -> (batch, s, nblocks·b)
+    bd = jnp.swapaxes(F, 1, 2).reshape(batch, s, nblocks * b)
+    top = jnp.concatenate([td, jnp.swapaxes(bd, -1, -2)], axis=-1)
+    bot = jnp.concatenate([bd, S], axis=-1)
+    return jnp.concatenate([top, bot], axis=-2)
+
+
+def pack(F, S, B, Bs):
+    """Encode (border, corner, RHS) as serve's (batch, n_T + s, s + k)
+    tail operand (module docstring "Serve packing"): rows [:n_T] are the
+    chain rows (Bᵀ beside the blocked-flat RHS), rows [n_T:] are the
+    corner rows (S beside the corner RHS)."""
+    batch, nblocks, s, b = F.shape
+    k = B.shape[-1]
+    n_t = nblocks * b
+    top = jnp.concatenate(
+        [jnp.swapaxes(F, -1, -2).reshape(batch, n_t, s),
+         B.reshape(batch, n_t, k)], axis=-1)
+    bot = jnp.concatenate([S, Bs], axis=-1)
+    return jnp.concatenate([top, bot], axis=-2)
+
+
+def unpack(P, nblocks: int, b: int):
+    """Invert `pack` from static shapes alone: s = rows − nblocks·b,
+    k = cols − s.  Returns (F, S, B, Bs)."""
+    batch, rows, cols = P.shape
+    n_t = nblocks * b
+    s = rows - n_t
+    k = cols - s
+    if s < 1 or k < 0:
+        raise ValueError(
+            f"arrowhead unpack: packed {P.shape} cannot carry an "
+            f"nblocks={nblocks}, b={b} chain (need rows > {n_t})")
+    ft = P[:, :n_t, :s].reshape(batch, nblocks, b, s)
+    return (jnp.swapaxes(ft, -1, -2), P[:, n_t:, :s],
+            P[:, :n_t, s:].reshape(batch, nblocks, b, k), P[:, n_t:, s:])

@@ -18,15 +18,23 @@ test's reference): ``ab`` has shape ``(u + 1, n)``; in LOWER form
 ``ab[u + i - j, j] = A[i, j]`` for ``i <= j`` (main diagonal in the last
 row).  The identity padding keeps the padded matrix SPD and the padded
 solution rows exactly zero for zero RHS rows, so un-padding is a slice.
+
+Round 15 adds the BORDERED variant: a banded matrix plus ``s`` explicit
+dense rows/columns coupling every unknown to a small dense corner —
+the classic bordered-banded system (constrained splines, periodic
+boundary wrap-around, equality-constrained banded least squares).  The
+same re-blocking plus a column-chunking of the border rows lands it on
+``models/arrowhead.posv`` unchanged (``solveh_bordered``).
 """
 
 from __future__ import annotations
 
 import jax.numpy as jnp
 
-from capital_tpu.models import blocktri
+from capital_tpu.models import arrowhead, blocktri
 
-__all__ = ["resolve_block", "to_blocktri", "solveh_banded"]
+__all__ = ["resolve_block", "to_blocktri", "solveh_banded",
+           "solveh_bordered"]
 
 #: default re-blocking size floor: blocks this small under-fill even the
 #: CPU scan steps; the bandwidth still wins when it is larger.
@@ -136,3 +144,68 @@ def solveh_banded(ab, rhs, *, lower: bool = False, block: int = 0,
         )
     x = X[0].reshape(nblocks * b, rhs.shape[1])[:n]
     return x[:, 0] if squeeze else x
+
+
+def solveh_bordered(ab, border, corner, rhs, rhs_corner, *,
+                    lower: bool = False, block: int = 0, **posv_kwargs):
+    """Solve the SPD bordered-banded system on the arrowhead fast path.
+
+    The matrix is ``[[T, Bᵀ], [B, S]]`` with ``T`` banded in
+    ``solveh_banded`` storage (``ab``, same ``lower`` convention),
+    ``border`` the explicit dense rows ``B`` of shape ``(s, n)``, and
+    ``corner`` the ``(s, s)`` dense block ``S``.  Re-blocks ``ab`` into
+    the chain exactly like ``solveh_banded``, chunks the border columns
+    into the per-block ``(s, b)`` coupling blocks ``models/arrowhead``
+    expects (zero columns over the identity tail padding keep the padded
+    matrix SPD and the arrowhead math exact), and rides
+    ``arrowhead.posv`` unchanged — extra keyword arguments flow through
+    (impl / partitions / partition_inner / precision).  ``rhs`` is
+    ``(n,)`` or ``(n, k)`` with ``rhs_corner`` matching over ``(s,)``;
+    returns ``(x, x_corner)`` of those shapes.  Breakdown raises like
+    ``solveh_banded``, with corner pivots reported in the ORIGINAL
+    bordered order ``n + s`` (the tail-padding offset is subtracted —
+    docs/ROBUSTNESS.md, corner pivot offset)."""
+    D, C, n = to_blocktri(ab, lower=lower, block=block)
+    border = jnp.asarray(border, D.dtype)
+    corner = jnp.asarray(corner, D.dtype)
+    if border.ndim != 2 or border.shape[1] != n:
+        raise ValueError(
+            f"banded: border must be (s, n) = (s, {n}) dense rows, got "
+            f"{border.shape}"
+        )
+    s = border.shape[0]
+    if corner.shape != (s, s):
+        raise ValueError(
+            f"banded: corner must be (s, s) = ({s}, {s}), got {corner.shape}"
+        )
+    rhs = jnp.asarray(rhs, D.dtype)
+    rhs_corner = jnp.asarray(rhs_corner, D.dtype)
+    squeeze = rhs.ndim == 1
+    if squeeze:
+        rhs, rhs_corner = rhs[:, None], rhs_corner[:, None]
+    if rhs.shape[0] != n or rhs_corner.shape[0] != s:
+        raise ValueError(
+            f"banded: rhs/rhs_corner have {rhs.shape[0]}/"
+            f"{rhs_corner.shape[0]} rows, operand orders are {n}/{s}"
+        )
+    nblocks, b = D.shape[0], D.shape[1]
+    pad = nblocks * b - n
+    # border columns chunk into per-block (s, b) couplings; the padded
+    # tail columns are zero, so the identity diagonal rows stay decoupled
+    F = jnp.pad(border, ((0, 0), (0, pad))).reshape(s, nblocks, b)
+    F = jnp.swapaxes(F, 0, 1)
+    Bp = jnp.pad(rhs, ((0, pad), (0, 0))).reshape(nblocks, b, rhs.shape[1])
+    X, Xs, info = arrowhead.posv(
+        D[None], C[None], F[None], corner[None], Bp[None],
+        rhs_corner[None], **posv_kwargs)
+    bad = int(info[0])
+    if bad:
+        if bad > nblocks * b:
+            bad -= pad  # corner pivots back to the unpadded order
+        raise ValueError(
+            f"banded: leading minor of order {bad} is not positive "
+            "definite (arrowhead posv info)"
+        )
+    x = X[0].reshape(nblocks * b, rhs.shape[1])[:n]
+    xs = Xs[0]
+    return (x[:, 0], xs[:, 0]) if squeeze else (x, xs)

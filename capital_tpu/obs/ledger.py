@@ -238,7 +238,7 @@ _REQ_STATS_CACHE = ("hits", "misses", "warmup_compiles", "hit_rate")
 #: serve) — an unknown key means the producer and the tooling drifted apart.
 _REQ_STATS_OPS = ("posv", "lstsq", "inv", "posv_blocktri",
                   "chol_update", "chol_downdate", "posv_cached",
-                  "blocktri_extend")
+                  "blocktri_extend", "posv_arrowhead")
 #: factor_cache counter block (serve/factorcache.FactorCache.stats):
 #: attached to request_stats only by engines that served factor-token
 #: traffic — records without it stay valid unchanged.
@@ -618,6 +618,62 @@ def validate_blocktri_measured(measured) -> list[str]:
     return probs
 
 
+def validate_arrowhead_measured(measured) -> list[str]:
+    """Schema problems of a bench:arrowhead measured block ([] = valid) —
+    the arrowhead-geometry fields the driver emits (nblocks / block /
+    border / n consistency, the chain impl, the structural-speedup
+    column of the ≥10x ``make bench-arrowhead`` gate).  Same
+    exemption-with-validation posture as blocktri / update / refine:
+    diff() validates every record whose metric starts with "arrowhead"
+    (malformed -> LedgerIncompatible) while the metric itself still
+    compares normally — the value is a speedup ratio over dense batched
+    posv, so a drop reads as "slower" like every other bench row."""
+    if not isinstance(measured, dict):
+        return [f"measured is {type(measured).__name__}, expected object"]
+    probs = []
+    for key in ("nblocks", "block", "border", "n", "batch", "nrhs"):
+        v = measured.get(key)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+            probs.append(f"{key} must be a positive int, got {v!r}")
+    nb, b, s, n = (measured.get(k)
+                   for k in ("nblocks", "block", "border", "n"))
+    if (isinstance(nb, int) and isinstance(b, int) and isinstance(s, int)
+            and isinstance(n, int) and n != nb * b + s):
+        probs.append(f"n {n} != nblocks*block+border {nb * b + s}")
+    if measured.get("impl") not in _BLOCKTRI_IMPLS:
+        probs.append(
+            f"impl must be one of {_BLOCKTRI_IMPLS}, "
+            f"got {measured.get('impl')!r}"
+        )
+    # a speedup row (the arrowhead_tflops shape; arrowhead_latency rows
+    # carry neither) must bring the whole proof bundle: both wall
+    # comparands AND the f64 reference residuals it gated on (factor =
+    # Schur completion vs a NumPy reference, solve = whole-matrix
+    # backward error) — a speedup row that never proved its answers is
+    # not a row this ledger wants
+    if "speedup" in measured:
+        for key in ("speedup", "arrow_ms", "dense_ms"):
+            v = measured.get(key)
+            if (not isinstance(v, (int, float)) or isinstance(v, bool)
+                    or not v > 0):
+                probs.append(f"{key} must be a positive number, got {v!r}")
+        for key in ("factor_resid", "solve_resid"):
+            v = measured.get(key)
+            if (not isinstance(v, (int, float)) or isinstance(v, bool)
+                    or v < 0):
+                probs.append(
+                    f"{key} must be a non-negative number, got {v!r}")
+    wm = measured.get("wall_ms")
+    if wm is not None:
+        if not isinstance(wm, dict):
+            probs.append(f"wall_ms must be an object, got {wm!r}")
+        else:
+            for p in _REQ_STATS_PCTS:
+                if not isinstance(wm.get(p), (int, float)):
+                    probs.append(f"wall_ms.{p} missing or non-numeric")
+    return probs
+
+
 #: update impls the bench driver can report (ops/update_small.IMPLS).
 _UPDATE_IMPLS = ("auto", "pallas", "xla")
 
@@ -829,6 +885,14 @@ def diff(
             if probs:
                 raise LedgerIncompatible(
                     "malformed blocktri bench record: " + "; ".join(probs)
+                )
+        if isinstance(meas, dict) and str(
+            meas.get("metric", "")
+        ).startswith("arrowhead"):
+            probs = validate_arrowhead_measured(meas)
+            if probs:
+                raise LedgerIncompatible(
+                    "malformed arrowhead bench record: " + "; ".join(probs)
                 )
         if isinstance(meas, dict) and str(
             meas.get("metric", "")

@@ -46,21 +46,24 @@ import jax
 
 def _workload(requests: int, seed: int):
     """Deterministic mixed workload touching >= 3 dense n-buckets, all
-    four ops (dense posv/inv/lstsq + the structured posv_blocktri), two
-    nrhs buckets, and two blocktri (nblocks, b) buckets — the mixed
-    dense + structured traffic the zero-recompile gate must cover."""
+    five ops (dense posv/inv/lstsq + the structured posv_blocktri and
+    posv_arrowhead), two nrhs buckets, two blocktri (nblocks, b) buckets,
+    and two arrowhead border buckets — the mixed dense + structured
+    traffic the zero-recompile gate must cover."""
     import numpy as np
 
     rng = np.random.default_rng(seed)
     ns = (12, 24, 48, 16, 30, 64)  # -> buckets 16 / 32 / 64
     ks = (1, 3)  # -> nrhs buckets 1 / 4
     bts = ((3, 6), (6, 12), (4, 24))  # -> (nblocks, b) buckets
-    # 5-long op cycle against the 6-long n cycle (coprime) so blocks sweep
+    borders = (3, 6)  # -> arrowhead border buckets 4 / 8
+    # 7-long op cycle against the 6-long n cycle (coprime) so blocks sweep
     # the bucket grid; requests arrive in blocks of 4 IDENTICAL shapes
     # (j = i // 4) so the capacity flush path sees full batches, while the
     # pump() cadence below (every 7 submissions, coprime with 4) still
     # catches partial blocks on the deadline path
-    ops = ("posv", "inv", "lstsq", "posv_blocktri", "lstsq")
+    ops = ("posv", "inv", "lstsq", "posv_blocktri", "lstsq",
+           "posv_arrowhead", "posv")
     out = []
     for i in range(requests):
         j = i // 4
@@ -71,7 +74,7 @@ def _workload(requests: int, seed: int):
             m = 4 * n
             A = rng.standard_normal((m, n))
             B = rng.standard_normal((m, k))
-        elif op == "posv_blocktri":
+        elif op in ("posv_blocktri", "posv_arrowhead"):
             nb, bb = bts[j % len(bts)]
             G = rng.standard_normal((nb, bb, bb))
             D = G @ G.transpose(0, 2, 1) / bb + 3.0 * np.eye(bb)
@@ -79,6 +82,20 @@ def _workload(requests: int, seed: int):
             C[0] = 0.0
             A = np.stack([D, C])
             B = rng.standard_normal((nb, bb, k))
+            if op == "posv_arrowhead":
+                # pack the border/corner/RHS tail (models/arrowhead.pack
+                # layout, built host-side in numpy)
+                s = borders[j % len(borders)]
+                n_t = nb * bb
+                F = 0.1 * rng.standard_normal((nb, s, bb))
+                S0 = rng.standard_normal((s, s))
+                S = S0 @ S0.T / s + 5.0 * np.eye(s)
+                Bs = rng.standard_normal((s, k))
+                top = np.concatenate(
+                    [F.transpose(0, 2, 1).reshape(n_t, s),
+                     B.reshape(n_t, k)], axis=1)
+                B = np.concatenate(
+                    [top, np.concatenate([S, Bs], axis=1)], axis=0)
         else:
             M = rng.standard_normal((n, n))
             A = M @ M.T / n + 3.0 * np.eye(n)
@@ -96,7 +113,7 @@ def _residual(op: str, A, B, x) -> float:
         n = A.shape[0]
         return float(np.linalg.norm(A @ x - np.eye(n)) / np.sqrt(n))
     B = np.asarray(B, dtype=np.float64)
-    if op == "posv_blocktri":
+    if op in ("posv_blocktri", "posv_arrowhead"):
         # assemble the dense matrix the chain represents and gate the
         # flattened solve residual like dense posv
         _, nb, bb, _ = A.shape
@@ -109,6 +126,14 @@ def _residual(op: str, A, B, x) -> float:
                 up = slice((i - 1) * bb, i * bb)
                 Ad[sl, up] = A[1, i]
                 Ad[up, sl] = A[1, i].T
+        if op == "posv_arrowhead":
+            # complete the dense arrowhead from the packed tail: its
+            # first s columns are [Bᵀ; S], the rest the flat RHS
+            s = B.shape[0] - n
+            Af = np.block([[Ad, B[:n, :s]],
+                           [B[:n, :s].T, B[n:, :s]]])
+            rhs = B[:, s:]
+            return float(np.linalg.norm(Af @ x - rhs) / np.linalg.norm(rhs))
         k = B.shape[-1]
         Bf, xf = B.reshape(n, k), x.reshape(n, k)
         return float(np.linalg.norm(Ad @ xf - Bf) / np.linalg.norm(Bf))
@@ -134,6 +159,7 @@ def _smoke(args) -> int:
         # two rungs of each blocktri axis
         nblocks_buckets=(4, 8),
         block_buckets=(8, 16, 32),
+        border_buckets=(4, 8),
         max_batch=4,
         max_delay_s=0.01,
         # every smoke bucket is <= batched_small.SMALL_N_MAX, so 'auto'

@@ -58,7 +58,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from capital_tpu.models import blocktri, cholesky, qr
+from capital_tpu.models import arrowhead, blocktri, cholesky, qr
 from capital_tpu.ops import batched_small, blocktri_small, lapack, update_small
 from capital_tpu.parallel import summa
 from capital_tpu.utils import tracing
@@ -219,6 +219,56 @@ def _batched_blocktri(precision, impl: str, blocktri_impl: str = "auto",
                                  precision=precision, impl=pick)
         return blocktri.posv(a[:, 0], a[:, 1], b, precision=precision,
                              impl=mapped, partitions=partitions)
+
+    return f
+
+
+def _batched_arrowhead(precision, impl: str, blocktri_impl: str = "auto",
+                       partitions: int = 0):
+    """The block-arrowhead bucket program: chain pack A = (batch, 2,
+    nblocks, b, b) like posv_blocktri, plus the packed tail operand
+    B = (batch, nblocks·b + s, s + k) (models/arrowhead.pack — border
+    transpose, corner, and both RHS halves in one array; every geometry
+    re-derives from the STATIC shapes, so bucket resolution and the
+    zero-recompile invariant are untouched).
+
+    THREE outputs (X_chain, X_corner, info): the chain half stays BLOCKED
+    (batch, nblocks, b, k) so `batching.crop` unpads it by plain slicing
+    like posv_blocktri's; the (batch, s, k) corner half rides the
+    executor's extras slot to the engine's arrowhead landing sink, which
+    crops and concatenates the flat (nblocks·b + s, k) response.
+
+    The impl vocabulary and the `blocktri_impl` algorithm knob map
+    exactly like `_batched_blocktri` — they reach the ONE widened chain
+    solve inside arrowhead.posv (k + s columns), so 'partitioned' runs
+    the Spike driver under the border solve."""
+    mapped = {"auto": "auto", "pallas": "pallas",
+              "pallas_split": "pallas", "vmap": "xla"}[impl]
+    if blocktri_impl not in blocktri.ALGORITHMS:
+        raise ValueError(
+            f"unknown blocktri_impl {blocktri_impl!r}: expected one of "
+            f"{blocktri.ALGORITHMS}")
+
+    def f(a, b):
+        nblocks, bs = a.shape[2], a.shape[3]
+        F, S, B, Bs = arrowhead.unpack(b, nblocks, bs)
+        if blocktri_impl == "partitioned":
+            return arrowhead.posv(a[:, 0], a[:, 1], F, S, B, Bs,
+                                  precision=precision, impl="partitioned",
+                                  partitions=partitions,
+                                  partition_inner=mapped)
+        if blocktri_impl == "scan" and mapped == "auto":
+            # pin the sequential algorithm, keep per-bucket kernel
+            # resolution — at the WIDENED k + s column count the chain
+            # sweeps actually run at (_batched_blocktri's idiom)
+            pick = blocktri_small.default_impl(
+                bs, B.shape[-1] + F.shape[2],
+                blocktri.resolve_seg(nblocks), a.dtype)
+            return arrowhead.posv(a[:, 0], a[:, 1], F, S, B, Bs,
+                                  precision=precision, impl=pick)
+        return arrowhead.posv(a[:, 0], a[:, 1], F, S, B, Bs,
+                              precision=precision, impl=mapped,
+                              partitions=partitions)
 
     return f
 
@@ -409,6 +459,9 @@ def batched(op: str, precision: str | None = "highest",
     if op == "posv_blocktri":
         return _batched_blocktri(precision, impl, blocktri_impl,
                                  blocktri_partitions)
+    if op == "posv_arrowhead":
+        return _batched_arrowhead(precision, impl, blocktri_impl,
+                                  blocktri_partitions)
     if op in ("chol_update", "chol_downdate"):
         return _batched_update(op, precision, impl)
     if op == "posv_cached":
@@ -510,6 +563,23 @@ def single(op: str, grid, precision: str | None = "highest", robust=None,
             X, info = blocktri.posv(a[None, 0], a[None, 1], b[None],
                                     precision=precision)
             return X[0], (info[0] if robust is not None else jnp.int32(0))
+
+        return f
+    if op == "posv_arrowhead":
+        # oversize arrowheads run as a batch of one, like posv_blocktri
+        # (impl='auto' picks the partitioned driver above
+        # PARTITION_MIN_NBLOCKS).  The single route has no extras slot,
+        # so the flat (nblocks·b + s, k) solution is assembled HERE —
+        # the same response layout the engine's arrowhead sink produces
+        # for batched requests.
+        def f(a, b):
+            nblocks, bs = a.shape[1], a.shape[2]
+            F, S, B, Bs = arrowhead.unpack(b[None], nblocks, bs)
+            X, Xs, info = arrowhead.posv(a[None, 0], a[None, 1], F, S, B,
+                                         Bs, precision=precision)
+            flat = jnp.concatenate(
+                [X[0].reshape(nblocks * bs, X.shape[-1]), Xs[0]], axis=0)
+            return flat, (info[0] if robust is not None else jnp.int32(0))
 
         return f
     raise ValueError(f"unknown serve op {op!r}")

@@ -31,7 +31,7 @@ import jax.numpy as jnp
 from capital_tpu.ops import masking
 from capital_tpu.utils import tracing
 
-OPS = ("posv", "lstsq", "inv", "posv_blocktri",
+OPS = ("posv", "lstsq", "inv", "posv_blocktri", "posv_arrowhead",
        "chol_update", "chol_downdate", "posv_cached", "blocktri_extend")
 
 #: ops that require a resident factor (engine.submit factor_token=...).
@@ -98,6 +98,15 @@ def bucket_for(op: str, a_shape, b_shape, dtype: str, cfg,
     (cfg.nblocks_buckets / cfg.block_buckets); nrhs shares the dense
     ladder.
 
+    posv_arrowhead rides the same chain pack for A and ONE packed tail
+    operand B = (nblocks·b + s, s + nrhs) (models/arrowhead.pack: columns
+    [:s] are the dense system's last s columns [Bᵀ; S], columns [s:] the
+    full RHS).  The chain buckets like posv_blocktri, the border width s
+    gets its OWN ladder (cfg.border_buckets — s is a structural rank, not
+    an RHS count), nrhs shares the dense ladder; the bucketed tail shape
+    is (nbb·bb + sb, sb + kb), from which the program re-derives every
+    geometry statically.
+
     The factor-residency ops bucket on the ENGINE-COMPOSED operands, not
     the wire payload: chol_update/chol_downdate as (resident R (n, n),
     V (n, k)) with k on the nrhs ladder; posv_cached as (resident R,
@@ -144,6 +153,18 @@ def bucket_for(op: str, a_shape, b_shape, dtype: str, cfg,
             return None
         return Bucket(op, dtype, (2, nbb, bb, bb), (nbb, bb, kb),
                       cfg.max_batch)
+    if op == "posv_arrowhead":
+        _, nblocks, b, _ = a_shape
+        s = b_shape[0] - nblocks * b
+        k = b_shape[1] - s
+        nbb = _pick(cfg.nblocks_buckets, nblocks)
+        bb = _pick(cfg.block_buckets, b)
+        sb = _pick(cfg.border_buckets, s)
+        kb = _pick(cfg.nrhs_buckets, k)
+        if nbb is None or bb is None or sb is None or kb is None:
+            return None
+        return Bucket(op, dtype, (2, nbb, bb, bb),
+                      (nbb * bb + sb, sb + kb), cfg.max_batch)
     if op in ("posv", "inv"):
         n = a_shape[0]
         nb = _pick(cfg.buckets, n)
@@ -174,6 +195,8 @@ def pad_operands(op: str, A, B, bucket: Bucket):
     with tracing.scope("serve::pad"):
         if op == "posv_blocktri":
             return _pad_blocktri(A, B, bucket)
+        if op == "posv_arrowhead":
+            return _pad_arrowhead(A, B, bucket)
         if op == "blocktri_extend":
             return _pad_blocktri_extend(A, B, bucket)
         if op in ("chol_update", "chol_downdate"):
@@ -220,6 +243,53 @@ def _pad_blocktri(A, B, bucket: Bucket):
     return pa, pb
 
 
+def _pad_arrowhead(A, P, bucket: Bucket):
+    """Structure-safe pad for the block-arrowhead operands: the chain pack
+    pads exactly like `_pad_blocktri` (diag(D_i, I) embeds, zero
+    couplings, appended identity blocks); in the packed tail operand the
+    border columns zero-pad (appended border columns couple to nothing),
+    the corner embeds as diag(S, I) (masking.embed_identity_tail), and
+    every RHS entry zero-pads.  The padded dense system is
+    diag(A_real_embedded, I): the appended border rows are all-zero, so
+    the padded Schur complement is diag(S̃, I) and the appended corner
+    rows solve to exact zeros.  For chain-LENGTH padding (nblocks only)
+    the real solution is BITWISE the unpadded one, the PR 10 chain-pad
+    claim extended through the completion: the appended blocks' border
+    couplings are exact zeros, so every Schur/back-substitution
+    contraction term they add is 0·x (tests/test_arrowhead.py asserts
+    it); block-size / border / nrhs padding is tight but not bitwise (the
+    contraction lengths change).
+
+    The chain rows of the tail operand are RE-BLOCKED before padding
+    (reshape to (nblocks, b, ·), pad each axis, re-flatten): a flat row
+    pad would interleave the appended block-tail rows wrongly when
+    bb > b."""
+    _, nblocks, b, _ = A.shape
+    nbb, bb = bucket.a_shape[1], bucket.a_shape[2]
+    n_t = nblocks * b
+    s = P.shape[0] - n_t
+    k = P.shape[1] - s
+    sb = bucket.b_shape[0] - nbb * bb
+    kb = bucket.b_shape[1] - sb
+    pa = jnp.pad(A, ((0, 0), (0, nbb - nblocks),
+                     (0, bb - b), (0, bb - b)))
+    eye = jnp.eye(bb, dtype=A.dtype)
+    tail = jnp.where(jnp.arange(bb) >= b, eye, jnp.zeros_like(eye))
+    blk = (jnp.arange(nbb) < nblocks)[:, None, None]
+    pa = pa.at[0].add(jnp.where(blk, tail, eye))
+    top = P[:n_t].reshape(nblocks, b, s + k)
+    ptop = jnp.concatenate(
+        [jnp.pad(top[..., :s],
+                 ((0, nbb - nblocks), (0, bb - b), (0, sb - s))),
+         jnp.pad(top[..., s:],
+                 ((0, nbb - nblocks), (0, bb - b), (0, kb - k)))],
+        axis=-1).reshape(nbb * bb, sb + kb)
+    pbot = jnp.concatenate(
+        [masking.embed_identity_tail(P[n_t:, :s], sb, sb),
+         jnp.pad(P[n_t:, s:], ((0, sb - s), (0, kb - k)))], axis=-1)
+    return pa, jnp.concatenate([ptop, pbot], axis=0)
+
+
 def _pad_blocktri_extend(A, carry, bucket: Bucket):
     """Structure-safe pad for the chain-extension operands: the appended
     blocks pad exactly like `_pad_blocktri` (diag(D_i, I) embeds, zero
@@ -245,8 +315,18 @@ def fill_problem(bucket: Bucket):
     identity operand (SPD for posv/inv, orthonormal columns for lstsq —
     its gram is I, so every op factors it cleanly) against a zero RHS.
     For posv_blocktri the fill is the identity CHAIN: identity diagonal
-    blocks, zero couplings — every block factors to L = I exactly."""
+    blocks, zero couplings — every block factors to L = I exactly; the
+    arrowhead fill couples that chain to an identity corner through a
+    zero border (the whole fill matrix is I)."""
     dt = jnp.dtype(bucket.dtype)
+    if bucket.op == "posv_arrowhead":
+        _, nbb, bb, _ = bucket.a_shape
+        eyes = jnp.broadcast_to(jnp.eye(bb, dtype=dt), (nbb, bb, bb))
+        fa = jnp.stack([eyes, jnp.zeros((nbb, bb, bb), dt)])
+        sb = bucket.b_shape[0] - nbb * bb
+        fb = jnp.zeros(bucket.b_shape, dt)
+        fb = fb.at[nbb * bb:, :sb].set(jnp.eye(sb, dtype=dt))
+        return fa, fb
     if bucket.op in ("posv_blocktri", "blocktri_extend"):
         _, nbb, bb, _ = bucket.a_shape
         eyes = jnp.broadcast_to(jnp.eye(bb, dtype=dt), (nbb, bb, bb))
@@ -290,6 +370,13 @@ def crop(op: str, X, a_shape, b_shape):
         return X[: a_shape[1], : b_shape[1]]
     if op == "posv_blocktri":
         return X[: a_shape[1], : a_shape[2], : b_shape[2]]
+    if op == "posv_arrowhead":
+        # X is the CHAIN half (nbb, bb, kb) — blocked, so plain slicing
+        # unpads; the corner half rides the program's extras slot and the
+        # engine's arrowhead sink crops + concatenates it (engine.py)
+        nblocks, b = a_shape[1], a_shape[2]
+        s = b_shape[0] - nblocks * b
+        return X[:nblocks, :b, : b_shape[1] - s]
     if op == "blocktri_extend":
         # stacked (2, nbb, bb, bb) [L; Wt] back to the appended blocks
         return X[:, : a_shape[1], : a_shape[2], : a_shape[2]]

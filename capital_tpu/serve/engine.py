@@ -81,6 +81,12 @@ class ServeConfig:
         padded blocks embed diag(D_i, I)).  Both join the config hash
         with the dense ladders — the blocktri buckets AOT-cache alongside
         dense buckets under the same discipline.
+    border_buckets: the posv_arrowhead border-width ladder (s — the
+        number of dense corner rows coupling the chain to the corner).
+        A structural rank, not an RHS count, so it gets its own ladder
+        rather than riding nrhs_buckets; padded borders append zero rows
+        and the corner embeds diag(S, I) (batching._pad_arrowhead).
+        Joins the config hash with the other ladders.
     blocktri_impl: which chain ALGORITHM the posv_blocktri bucket
         programs compile (models/blocktri.ALGORITHMS): 'auto' lets
         posv's dispatch pick (the partitioned Spike driver above
@@ -146,6 +152,7 @@ class ServeConfig:
     nrhs_buckets: tuple[int, ...] = (1, 8, 64)
     nblocks_buckets: tuple[int, ...] = (8, 32, 64)
     block_buckets: tuple[int, ...] = (32, 64, 128)
+    border_buckets: tuple[int, ...] = (8, 16, 32)
     blocktri_impl: str = "auto"
     blocktri_partitions: int = 0
     max_batch: int = 8
@@ -220,6 +227,7 @@ class SolveEngine:
         # when and where programs run, never what was compiled.
         ident = repr((cfg.buckets, cfg.rows_buckets, cfg.nrhs_buckets,
                       cfg.nblocks_buckets, cfg.block_buckets,
+                      cfg.border_buckets,
                       cfg.max_batch, cfg.precision, cfg.robust,
                       cfg.small_n_impl, cfg.tail_fuse_depth,
                       cfg.blocktri_impl, cfg.blocktri_partitions))
@@ -250,17 +258,25 @@ class SolveEngine:
             # forced pallas included: api._batched_pallas falls back to the
             # vmap program for f64, so the executable is NOT small-route
             return False
-        if bucket.op in ("posv_blocktri", "blocktri_extend"):
+        if bucket.op in ("posv_blocktri", "blocktri_extend",
+                         "posv_arrowhead"):
             # the chain resolves through blocktri_small's own gate (per
             # scan step, not per bucket problem); impl mapping mirrors
             # api._batched_blocktri ('vmap'->xla handled above, forced
             # pallas variants below).  extend's scan step is the factor
-            # step at k = b (no RHS rides the chain).
+            # step at k = b (no RHS rides the chain); the arrowhead's
+            # widened chain solve runs at s + nrhs columns, which is
+            # exactly the packed tail's column count.
             if impl in ("pallas", "pallas_split"):
                 return True
             _, nblocks, b, _ = bucket.a_shape
             seg = blocktri.resolve_seg(nblocks)
-            k = bucket.b_shape[2] if bucket.op == "posv_blocktri" else b
+            if bucket.op == "posv_blocktri":
+                k = bucket.b_shape[2]
+            elif bucket.op == "posv_arrowhead":
+                k = bucket.b_shape[1]
+            else:
+                k = b
             return blocktri_small.default_impl(
                 b, k, seg, dtype
             ) == "pallas"
@@ -470,6 +486,24 @@ class SolveEngine:
                     f"posv_blocktri needs B = (nblocks, b, nrhs) riding "
                     f"A {A.shape}, got {None if B is None else B.shape}"
                 )
+        if op == "posv_arrowhead":
+            if (A.ndim != 4 or A.shape[0] != 2
+                    or A.shape[2] != A.shape[3]):
+                raise ValueError(
+                    f"posv_arrowhead needs A = (2, nblocks, b, b) — "
+                    f"[diagonal blocks, sub-diagonal blocks], the "
+                    f"posv_blocktri chain pack — got {A.shape}"
+                )
+            n_t = A.shape[1] * A.shape[2]
+            if (B is None or B.ndim != 2 or B.shape[0] <= n_t
+                    or B.shape[1] <= B.shape[0] - n_t):
+                raise ValueError(
+                    f"posv_arrowhead needs the packed tail B = "
+                    f"(nblocks·b + s, s + nrhs) with s >= 1, nrhs >= 1 "
+                    f"(models/arrowhead.pack) riding A {A.shape} "
+                    f"(nblocks·b = {n_t}), got "
+                    f"{None if B is None else B.shape}"
+                )
         if op in ("posv", "lstsq") and (B is None or B.ndim != 2
                                         or B.shape[0] != A.shape[0]):
             raise ValueError(
@@ -504,10 +538,12 @@ class SolveEngine:
                 t_enq,
             )
             return ticket
-        if op == "posv_blocktri":
+        if op in ("posv_blocktri", "posv_arrowhead"):
             # impl split: the bucketed program follows the engine's
             # algorithm knobs; the oversize single route runs posv's own
-            # defaults (api.single), so it is counted that way
+            # defaults (api.single), so it is counted that way.  The
+            # arrowhead counts too — its widened chain solve runs the
+            # same algorithm resolution (api._batched_arrowhead).
             self.stats.note_blocktri_impl(
                 self._blocktri_algorithm(bucket.a_shape[1], bucket.dtype)
                 if bucket is not None
@@ -523,8 +559,12 @@ class SolveEngine:
                 self._run_single(ticket, op, A, B, t_enq)
             return ticket
         pa, pb = batching.pad_operands(op, A, B, bucket)
-        sink = (self._refine_sink(op) if bucket.tier == "guaranteed"
-                else None)
+        if bucket.tier == "guaranteed":
+            sink = self._refine_sink(op)
+        elif op == "posv_arrowhead":
+            sink = self._arrowhead_sink(tuple(A.shape), tuple(B.shape))
+        else:
+            sink = None
         self._admit(ticket, bucket, pa, pb, tuple(A.shape),
                     tuple(B.shape) if B is not None else None, t_enq,
                     sink=sink)
@@ -893,6 +933,24 @@ class SolveEngine:
                  "dtype": str(L.dtype)},
             )
             return x, raw_info, None
+
+        return sink
+
+    def _arrowhead_sink(self, a_shape, b_shape):
+        """Landing hook for posv_arrowhead: the 3-output bucket program
+        (api._batched_arrowhead) lands the BLOCKED chain half through
+        batching.crop with the padded corner half in the extras slot;
+        crop the corner and concatenate the flat (nblocks·b + s, nrhs)
+        response — the same layout the oversize single route returns, so
+        clients see one contract on both routes."""
+        nblocks, b = a_shape[1], a_shape[2]
+        s = b_shape[0] - nblocks * b
+        k = b_shape[1] - s
+
+        def sink(x, extras, raw_info):
+            flat = jnp.concatenate(
+                [x.reshape(nblocks * b, k), extras[0][:s, :k]], axis=0)
+            return flat, raw_info, None
 
         return sink
 

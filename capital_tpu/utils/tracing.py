@@ -196,6 +196,18 @@ PHASE_REGISTRY: tuple[str, ...] = (
     # pairwise R-stack reduction levels, and the top-down Q assembly
     # gemms, priced whole via tsqr_flops.
     "IR::residual", "IR::correct", "QR::tsqr",
+    # block-arrowhead completion (models/arrowhead.py, docs/SERVING.md
+    # "posv_arrowhead").  The chain half of the factorization rides
+    # models/blocktri UNCHANGED and keeps emitting its own BT::* phases
+    # (the widened [RHS | Bᵀ] forward/backward sweeps are priced there at
+    # k + s columns); the AH::* tags price only the completion work the
+    # arrowhead adds on top.  AH::schur wraps the Schur-complement
+    # assembly S̃ = S − B·T⁻¹·Bᵀ (one batched border gemm) plus the dense
+    # corner Cholesky; AH::border wraps the corner RHS correction, the
+    # (s, s) triangular corner solves, and the chain back-substitution
+    # x_T = Z − Z_B·x_S.  Emits fire outside every scan (the chain scans
+    # live inside blocktri) — the BT::factor rationale.
+    "AH::schur", "AH::border",
 )
 _PHASE_SET: set[str] = set(PHASE_REGISTRY)
 
@@ -382,7 +394,8 @@ class Recorder:
         return t
 
     def estimate_seconds(
-        self, spec: Optional[DeviceSpec] = None, dtype=jnp.float32, efficiency: float = 0.6
+        self, spec: Optional[DeviceSpec] = None, dtype=jnp.float32,
+        efficiency: float = 0.6, refine_sweeps: float = 1.0,
     ) -> dict[str, tuple[float, float]]:
         """Per-phase (comp_s, comm_s) estimates from the device model.
 
@@ -394,13 +407,24 @@ class Recorder:
         not bytes).  Schedule-inserted copies (copy_bytes) are local HBM
         traffic, priced at hbm_gbps into the comp term — they spend device
         time, not interconnect time, which is exactly why the copy-free
-        explicit route ranks above the materializing one at equal flops."""
+        explicit route ranks above the materializing one at equal flops.
+
+        refine_sweeps scales the IR::* phases' flops: the model emits ONE
+        refinement sweep per refine() call (the while_loop trip count is
+        data-dependent — see the IR::* registry note) while the traffic
+        actually executes a measured number of them.  Callers price real
+        guaranteed-tier traffic by feeding the measured mean from the
+        serve stats `refine` block (`refine_sweeps_from_stats`); the
+        default 1.0 keeps the historical one-sweep estimate."""
         spec = spec or device_spec()
         peak = spec.peak_tflops(dtype) * 1e12 * efficiency
         out = {}
         for tag, s in self.stats.items():
             comm = s.comm_bytes / (spec.ici_gbps * 1e9) + s.collectives * spec.alpha_s
-            comp = s.flops / peak + s.copy_bytes / (spec.hbm_gbps * 1e9)
+            flops = s.flops
+            if tag.startswith("IR::"):
+                flops *= refine_sweeps
+            comp = flops / peak + s.copy_bytes / (spec.hbm_gbps * 1e9)
             out[tag] = (comp, comm)
         return out
 
@@ -621,6 +645,42 @@ def refine_sweep_flops(n: int, k: int) -> float:
     sweep (see the IR::* registry note) and the measured counts live in
     serve stats."""
     return 2.0 * n * n * k + 2.0 * batched_trsm_flops(n, k) + 2.0 * n * k
+
+
+def arrowhead_schur_flops(nblocks: int, b: int, s: int) -> float:
+    """Schur-complement completion of the arrowhead corner, per problem
+    (AH::schur): the border reduction gemm B·Z_B over the chain
+    (2·nblocks·b·s²) plus the dense corner Cholesky of S̃.  The corner
+    rides `lax.linalg.cholesky` — a real dense potrf, not a masked sweep —
+    so the textbook s³/3 IS the executed count there; the widened chain
+    sweeps that produced Z_B are priced inside blocktri under BT::*."""
+    return 2.0 * nblocks * b * s * s + s**3 / 3.0
+
+
+def arrowhead_border_flops(nblocks: int, b: int, s: int, k: int) -> float:
+    """Corner solve + chain back-substitution of the arrowhead completion,
+    per problem (AH::border): the corner RHS correction y = b_S − B·Z_rhs
+    (2·n·s·k over the chain), the two dense (s, s) triangular corner
+    solves at width k (2s²k, XLA triangular_solve), and the chain
+    back-substitution x_T = Z_rhs − Z_B·x_S (another 2·n·s·k)."""
+    n = nblocks * b
+    return 4.0 * n * s * k + 2.0 * s * s * k
+
+
+def refine_sweeps_from_stats(refine_block: Optional[dict]) -> float:
+    """Mean executed refinement sweeps per request, read from a serve
+    stats `refine` snapshot block (stats.Collector) — the feed for
+    `Recorder.estimate_seconds(refine_sweeps=...)`.  Uses the iters p50
+    (the typical request's sweep count); absent or malformed blocks fall
+    back to the model's one-sweep default, floored at 1.0 because every
+    refined request runs at least the residual check sweep."""
+    if not refine_block:
+        return 1.0
+    iters = refine_block.get("iters") or {}
+    try:
+        return max(float(iters.get("p50", 1.0)), 1.0)
+    except (TypeError, ValueError):
+        return 1.0
 
 
 def refine_lstsq_sweep_flops(m: int, n: int, k: int) -> float:

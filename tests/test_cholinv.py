@@ -139,6 +139,9 @@ class TestFactor:
                 g, A, CholinvConfig(balance="tile_cyclic", mode="xla")
             )
 
+    @pytest.mark.slow  # heaviest tier-1 test (~34s on the 1-core rig);
+    # the persistent layout keeps cheap coverage via test_summa's
+    # persistent in-place schedules and the multichip dryrun face
     def test_persistent_layout_matches_block(self, grid2x2x1):
         # balance='tile_cyclic_persistent': ONE symmetric tile-cyclic
         # permute at entry, every recursion window read/written through
@@ -265,6 +268,9 @@ class TestReviewRegressions:
         assert top_split(24, cfg) == 24  # single base-case window
 
 
+@pytest.mark.slow  # ~27s of plan compiles on the 1-core rig; the
+# structural gate itself is trace-time, so the full (unmarked) suite
+# still trips it
 def test_zeros_fast_path_gated_on_leaf_alignment(monkeypatch):
     """split>=2 plans produce leaves smaller than the zero-fill tile; the
     dead-lower fast path must fall back to full jnp.zeros there or real

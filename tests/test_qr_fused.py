@@ -181,6 +181,8 @@ class TestFusedPipeline:
             grid1, 65536, 8192, "pallas", g=64, dtype=bf
         ) == "panels"
 
+    @pytest.mark.slow  # ~24s (n=2048 f64 on the 1-core rig); the
+    # wide-n route's cheaper dispatch pins stay in tier-1
     def test_wide_n_cholinv_route_matches_unfused(self, grid1):
         # n >= 2048 routes the gram factor through the recursive cholinv
         # on the UNASSEMBLED gram (zeros below the valid upper triangle) —

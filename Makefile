@@ -7,7 +7,8 @@ PY ?= python
 
 .PHONY: all test benchmarking bench-explicit bench-small bench-blocktri \
 	bench-blocktri-par bench-arrowhead bench-update bench-refine tune \
-	audit lint robust serve-smoke serve-bench serve-replicas native clean
+	audit lint robust serve-smoke serve-bench serve-replicas serve-trace \
+	native clean
 
 all: test
 
@@ -156,7 +157,7 @@ bench-refine:
 # through obs trace-report — the same double-entry discipline as lint.
 # The generous 0.995 bound absorbs CPU-interpret emulation; what it pins
 # is that attribution works end to end.
-audit: serve-smoke serve-bench serve-replicas bench-blocktri \
+audit: serve-smoke serve-bench serve-replicas serve-trace bench-blocktri \
 	bench-blocktri-par bench-arrowhead bench-update bench-refine lint
 	$(PY) -m capital_tpu.obs audit cholinv --n 4096 --platform cpu
 	$(PY) -m capital_tpu.obs audit cacqr --m 16384 --n 512 --platform cpu
@@ -244,6 +245,29 @@ serve-replicas:
 	$(PY) -m capital_tpu.obs serve-report serve_replicas.jsonl \
 		--aggregate --min-replicas 2 --min-hit-rate 1.0
 
+# per-request tracing + live-window telemetry gate (docs/OBSERVABILITY.md
+# "Per-request tracing and live windows"): the smoke under --trace must
+# land 100% complete monotonic span chains (admit -> ... -> respond) under
+# the pinned 25 ms bubble tolerance — gated in-run AND re-gated from the
+# ledger by serve-report (double-entry, same discipline as lint).  The
+# loadgen leg runs both schedulers with 0.2 s rolling windows and a 60 s
+# deadline, gated on >= 3 serve:window records whose internal coherence
+# (percentile ordering, histogram/count sums) validate_serve_window pins
+# on every read.  obs timeline then proves the chrome-trace export path
+# end to end — it exits non-zero on an empty or malformed trace ledger,
+# so a silently-dead producer can never pass
+serve-trace:
+	rm -f serve_trace.jsonl serve_trace_chrome.json
+	$(PY) -m capital_tpu.serve smoke --platform cpu --requests 42 \
+		--trace --bubble-tol-ms 25 --ledger serve_trace.jsonl
+	$(PY) -m capital_tpu.serve loadgen --platform cpu --requests 120 \
+		--concurrency 8 --window-s 0.2 --min-windows 3 \
+		--deadline-ms 60000 --trace --ledger serve_trace.jsonl
+	$(PY) -m capital_tpu.obs serve-report serve_trace.jsonl \
+		--min-trace-complete 1.0 --min-windows 3
+	$(PY) -m capital_tpu.obs timeline serve_trace.jsonl \
+		--chrome serve_trace_chrome.json
+
 # breakdown detection / shifted-CholeskyQR recovery / fault-injection suite
 # (docs/ROBUSTNESS.md); CPU rig — tests/conftest.py provides the 8-device
 # virtual mesh and enables x64
@@ -258,5 +282,5 @@ clean:
 		lint_report.jsonl bench_small.jsonl serve_bench.jsonl serve_cache \
 		bench_trace.jsonl serve_replicas.jsonl serve_replicas_cache \
 		bench_blocktri.jsonl bench_update.jsonl bench_refine.jsonl \
-		bench_arrowhead.jsonl
+		bench_arrowhead.jsonl serve_trace.jsonl serve_trace_chrome.json
 	find . -name __pycache__ -type d -exec rm -rf {} +

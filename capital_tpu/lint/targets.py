@@ -21,8 +21,9 @@ import jax.numpy as jnp
 from capital_tpu.lint.program import ProgramTarget
 
 TARGET_NAMES = ("cholinv", "cacqr", "serve", "batched_small", "serve_sched",
-                "cholinv_fused", "blocktri", "blocktri_partitioned",
-                "arrowhead", "update_small", "refine")
+                "serve_traced", "cholinv_fused", "blocktri",
+                "blocktri_partitioned", "arrowhead", "update_small",
+                "refine")
 
 
 def _grid():
@@ -375,6 +376,55 @@ def serve_sched_target(
     )
 
 
+def serve_traced_target(
+    n: int = 64, nrhs: int = 4, capacity: int = 4, dtype=jnp.float32,
+) -> ProgramTarget:
+    """The traced serve dispatch program: the serve_sched stage/dispatch
+    pair with the per-request span stamping the engine performs around it
+    (obs/spans.RequestTrace.extend) executed inline, exactly where the
+    serve path stamps — before staging, at executable resolution, at
+    dispatch issue.
+
+    The property this target pins is the tracing tentpole's core claim:
+    span stamps are a pure HOST-side observer.  They run at trace time,
+    never become program equations, and above all never become host
+    callbacks — ``rule_no_host_sync`` proves the traced program carries
+    zero ``pure_callback``/``io_callback``/infeed primitives, because a
+    span stamp that leaked into the program as a callback would serialize
+    the very device stream it claims to observe.  The stamps must also
+    not break phase coverage: ``SV::stage`` / ``SV::dispatch`` still name
+    every flop.  ``flops_audited=False`` and no donation for the same
+    interpret-rig reasons as serve_sched_target."""
+    import time
+
+    from capital_tpu.obs import spans
+    from capital_tpu.serve import api
+    from capital_tpu.utils import tracing
+
+    dt = jnp.dtype(dtype)
+    a_sds = jax.ShapeDtypeStruct((capacity, n, n), dt)
+    b_sds = jax.ShapeDtypeStruct((capacity, n, nrhs), dt)
+    solve = api.batched("posv")
+    log = spans.TraceLog()
+
+    def step(a, b):
+        tr = log.start(0, "posv", time.monotonic())
+        with tracing.scope("SV::stage"):
+            # pad_operands' identity-tail symmetrization, in-program form
+            a_sym = 0.5 * (a + jnp.swapaxes(a, -1, -2))
+        tr.extend("admit")
+        tr.extend("cache_lookup")
+        with tracing.scope("SV::dispatch"):
+            X, info = solve(a_sym, b)
+        tr.extend("batch_form")
+        return X, info
+
+    return ProgramTarget(
+        name=f"serve-traced-posv-b{capacity}-n{n}", fn=step,
+        args=(a_sds, b_sds), flops_audited=False,
+    )
+
+
 def flagship_targets(names=None) -> list[ProgramTarget]:
     """The `make lint` program-pass set.  `names` filters to a subset of
     TARGET_NAMES (all three families by default)."""
@@ -391,6 +441,8 @@ def flagship_targets(names=None) -> list[ProgramTarget]:
             out.extend(batched_small_targets())
         elif name == "serve_sched":
             out.append(serve_sched_target())
+        elif name == "serve_traced":
+            out.append(serve_traced_target())
         elif name == "cholinv_fused":
             out.append(cholinv_fused_target())
         elif name == "blocktri":

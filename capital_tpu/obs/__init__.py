@@ -17,6 +17,26 @@ engine that flags regressions between two ledgers.  The CLI lives in
 tolerance policy are documented in docs/OBSERVABILITY.md.
 """
 
-from capital_tpu.obs import ledger, xla_audit  # noqa: F401
+__all__ = ["ledger", "spans", "xla_audit"]
 
-__all__ = ["ledger", "xla_audit"]
+# PEP 562 lazy submodule exports (same pattern as capital_tpu/serve):
+# xla_audit imports jax at module level, and the host-only serve dispatch
+# plane (router.py) imports `capital_tpu.obs.spans` — an eager import
+# here would drag jax into every router/replica process and break the
+# round-10 host-only contract the lint host-only-dispatch rule pins.
+
+
+def __getattr__(name: str):
+    if name not in __all__:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        )
+    import importlib
+
+    value = importlib.import_module(f"{__name__}.{name}")
+    globals()[name] = value  # cache: next access skips __getattr__
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

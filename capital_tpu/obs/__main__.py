@@ -27,6 +27,14 @@ own pass/fail outcome — the second half of ``make lint``.
 wall split plus bubble_frac — and optionally gates on bubble_frac
 (docs/OBSERVABILITY.md "Phase-level wall-time attribution").
 
+``timeline`` renders the serve:trace records of a ledger (obs/spans.py;
+``serve smoke --trace`` / ``loadgen --trace`` producers): per-run chain
+completeness, the per-span duration split, SLO-violation attribution, and
+— with ``--chrome out.json`` — a Chrome-trace-event export for
+chrome://tracing / Perfetto waterfall inspection.  It exits 1 when the
+ledger carries NO serve:trace records (a dead timeline never reads as a
+quiet pass) and 2 on a malformed one.
+
 Examples::
 
     python -m capital_tpu.obs audit cholinv --n 4096
@@ -195,17 +203,31 @@ def _robust_gate(args) -> int:
 
 
 def _serve_report(args) -> int:
-    """Summarize the serve:request_stats records of a ledger, with optional
-    gates (the `make serve-smoke` second half).  Exit 2 on a malformed
-    record, 1 on a gate failure (or gates requested with no records)."""
+    """Summarize the serve records of a ledger — request_stats snapshots
+    plus the serve:trace / serve:window telemetry records — with optional
+    gates (the `make serve-smoke` / `make serve-trace` second half).
+    Exit 2 on a malformed record, 1 on a gate failure (or gates requested
+    with no records to exercise them)."""
     from capital_tpu.obs import ledger
 
     recs = ledger.read(args.ledger)
     rows = [r for r in recs if r.get("request_stats") is not None]
+    trows = [r for r in recs if r.get("serve_trace") is not None]
+    wrows = [r for r in recs if r.get("serve_window") is not None]
     bad = 0
     for i, r in enumerate(rows):
         for p in ledger.validate_request_stats(r["request_stats"]):
             print(f"malformed request_stats record #{i}: {p}",
+                  file=sys.stderr)
+            bad += 1
+    for i, r in enumerate(trows):
+        for p in ledger.validate_serve_trace(r["serve_trace"]):
+            print(f"malformed serve_trace record #{i}: {p}",
+                  file=sys.stderr)
+            bad += 1
+    for i, r in enumerate(wrows):
+        for p in ledger.validate_serve_window(r["serve_window"]):
+            print(f"malformed serve_window record #{i}: {p}",
                   file=sys.stderr)
             bad += 1
     if bad:
@@ -219,9 +241,11 @@ def _serve_report(args) -> int:
                 or args.max_refine_iters is not None
                 or args.min_converged_frac is not None
                 or args.min_replicas is not None
+                or args.min_trace_complete is not None
+                or args.min_windows is not None
                 or args.aggregate)
-    if not rows:
-        print(f"# no request_stats records in {args.ledger} "
+    if not rows and not trows and not wrows:
+        print(f"# no serve records in {args.ledger} "
               f"({len(recs)} records total)")
         return 1 if gates_on else 0
     failures = []
@@ -358,6 +382,65 @@ def _serve_report(args) -> int:
             "--max-p99-ms-small requested but no record carries a "
             "latency_ms_small block (no small-bucket traffic served?)"
         )
+    # per-request span traces (serve:trace records — obs/spans.py): the
+    # --min-trace-complete gate reads each record's complete/requests
+    # verdict, computed under the record's own pinned bubble tolerance.
+    for i, r in enumerate(trows):
+        st = r["serve_trace"]
+        print(
+            f"# trace[{i}] requests={st['requests']} "
+            f"complete={st['complete']} dropped={st['dropped']} "
+            f"violations={st['violations']} "
+            f"bubble_tol_ms={st['bubble_tol_ms']}"
+        )
+    if args.min_trace_complete is not None:
+        if not trows:
+            failures.append(
+                "--min-trace-complete requested but no record carries a "
+                "serve_trace block (run the producer with --trace?)"
+            )
+        for i, r in enumerate(trows):
+            st = r["serve_trace"]
+            if st["requests"] == 0:
+                failures.append(
+                    f"trace record #{i}: zero traced requests — an empty "
+                    "trace log can never satisfy --min-trace-complete"
+                )
+                continue
+            frac = st["complete"] / st["requests"]
+            if frac < args.min_trace_complete:
+                from capital_tpu.obs import spans
+
+                broken = [
+                    t.get("request_id")
+                    for t in st["traces"]
+                    if spans.trace_dict_problems(t, st["bubble_tol_ms"])
+                ]
+                failures.append(
+                    f"trace record #{i}: {st['complete']}/{st['requests']} "
+                    f"chains complete ({frac:.3f} < "
+                    f"{args.min_trace_complete}); incomplete request ids: "
+                    f"{broken[:8]}"
+                )
+    # rolling windows (serve:window records — serve/telemetry.py): the
+    # --min-windows gate counts RECORDS, one per closed non-empty window,
+    # so it fails loudly both when telemetry was never enabled and when
+    # the run was too short to close enough windows.
+    if wrows:
+        wreq = sum(r["serve_window"]["requests"] for r in wrows)
+        worst = max(r["serve_window"]["latency_ms"]["p99"] for r in wrows)
+        shed = sum(r["serve_window"]["shed"] for r in wrows)
+        print(
+            f"# windows: {len(wrows)} record(s) requests={wreq} "
+            f"shed={shed} worst p99={worst}ms "
+            f"window_s={wrows[0]['serve_window']['window_s']}"
+        )
+    if args.min_windows is not None and len(wrows) < args.min_windows:
+        failures.append(
+            f"{len(wrows)} serve_window record(s) < --min-windows "
+            f"{args.min_windows} (telemetry not enabled via --window-s, "
+            "or the run closed too few non-empty windows)"
+        )
     # cross-replica aggregation (docs/SERVING.md "Multi-replica serving"):
     # fold every replica-TAGGED record through stats.merge_snapshots and
     # report the fleet view — summed counts, worst tail, summed router-block
@@ -410,9 +493,28 @@ def _serve_report(args) -> int:
                 )
             if (args.min_hit_rate is not None
                     and merged["cache"]["hit_rate"] < args.min_hit_rate):
+                # name the offenders: a fleet-level number alone sends the
+                # operator hunting through every replica's log — the
+                # per-replica rates say WHICH engine's cache went cold
+                per = {
+                    r["request_stats"]["replica_id"]:
+                        r["request_stats"]["cache"]["hit_rate"]
+                    for r in tagged
+                }
+                offenders = sorted(
+                    rid for rid, hr in per.items()
+                    if hr < args.min_hit_rate
+                )
+                per_note = " ".join(
+                    f"{rid}={per[rid]:.3f}" for rid in sorted(per)
+                )
+                who = (str(offenders) if offenders
+                       else "(none individually — the merged union "
+                            "fell below the gate)")
                 failures.append(
                     f"aggregate hit_rate {merged['cache']['hit_rate']:.3f} "
-                    f"< {args.min_hit_rate}"
+                    f"< {args.min_hit_rate} (per-replica: {per_note}; "
+                    f"offending replica_id(s): {who})"
                 )
     if args.min_residency_hit_rate is not None and not factor_seen:
         failures.append(
@@ -436,7 +538,8 @@ def _serve_report(args) -> int:
         print(f"serve-report gate FAIL: {f}", file=sys.stderr)
     if failures:
         return 1
-    print(f"# serve-report OK ({len(rows)} request_stats record(s))")
+    print(f"# serve-report OK ({len(rows)} request_stats, "
+          f"{len(trows)} serve_trace, {len(wrows)} serve_window record(s))")
     return 0
 
 
@@ -546,6 +649,90 @@ def _trace_report(args) -> int:
     if failures:
         return 1
     print(f"# trace-report OK ({len(rows)} phase-attribution record(s))")
+    return 0
+
+
+def _timeline(args) -> int:
+    """Render the serve:trace records of a ledger: per-run completeness,
+    the per-span duration split, the slowest requests, SLO-violation
+    attribution, and (with --chrome) the Chrome-trace-event export.  Exit
+    2 on a malformed record; exit 1 when the ledger carries NO serve_trace
+    records — a timeline with nothing to show is a producer wiring bug
+    (--trace not passed), never a quiet pass."""
+    from collections import Counter, defaultdict
+
+    from capital_tpu.obs import ledger, spans
+
+    recs = ledger.read(args.ledger)
+    rows = [r for r in recs if r.get("serve_trace") is not None]
+    bad = 0
+    for i, r in enumerate(rows):
+        for p in ledger.validate_serve_trace(r["serve_trace"]):
+            print(f"malformed serve_trace record #{i}: {p}",
+                  file=sys.stderr)
+            bad += 1
+    if bad:
+        return 2
+    if not rows:
+        print(
+            f"timeline: no serve_trace records in {args.ledger} "
+            f"({len(recs)} records total) — run the serve producer with "
+            "--trace to emit them", file=sys.stderr,
+        )
+        return 1
+    traces = []
+    for i, r in enumerate(rows):
+        st = r["serve_trace"]
+        print(
+            f"# [{i}] requests={st['requests']} complete={st['complete']} "
+            f"dropped={st['dropped']} violations={st['violations']} "
+            f"bubble_tol_ms={st['bubble_tol_ms']}"
+        )
+        traces.extend(st["traces"])
+    # where a request's life goes, per span name across every trace
+    durs = defaultdict(list)
+    for t in traces:
+        for sp in t.get("spans", ()):
+            durs[sp["name"]].append(sp["dur_ms"])
+    total = sum(sum(v) for v in durs.values())
+    for name in spans.CHAIN:
+        if name not in durs:
+            continue
+        v = durs[name]
+        share = 100.0 * sum(v) / total if total else 0.0
+        print(
+            f"#   {name:12s} n={len(v):5d} mean={sum(v) / len(v):9.3f} ms "
+            f"max={max(v):9.3f} ms  {share:5.1f}%"
+        )
+    for t in sorted(traces, key=lambda t: -t.get("latency_ms", 0.0)
+                    )[: args.top]:
+        chain = " ".join(
+            f"{sp['name']}={sp['dur_ms']:.3f}" for sp in t.get("spans", ())
+        )
+        print(
+            f"#   slow request {t.get('request_id')} "
+            f"[{t.get('kind')}/{t.get('op')}"
+            f"{'/' + t['replica_id'] if t.get('replica_id') else ''}] "
+            f"{t.get('latency_ms')}ms: {chain}"
+        )
+    viol = [t for t in traces if t.get("violated")]
+    if viol:
+        attr = Counter(str(t.get("attribution")) for t in viol)
+        print(
+            f"#   SLO violations: {len(viol)}/{len(traces)} — attribution "
+            + " ".join(f"{k}={n}" for k, n in attr.most_common())
+        )
+    if args.chrome:
+        chrome = spans.to_chrome(traces)
+        with open(args.chrome, "w") as f:
+            json.dump(chrome, f)
+        print(
+            f"# chrome trace: {len(chrome['traceEvents'])} events -> "
+            f"{args.chrome} (open in chrome://tracing or "
+            "https://ui.perfetto.dev)"
+        )
+    print(f"# timeline OK ({len(rows)} serve_trace record(s), "
+          f"{len(traces)} trace(s))")
     return 0
 
 
@@ -659,6 +846,18 @@ def build_parser() -> argparse.ArgumentParser:
                    help="fail unless the ledger carries at least this many "
                         "distinct replica_id tags (the it-really-was-"
                         "multi-replica gate for make serve-replicas)")
+    s.add_argument("--min-trace-complete", type=float, default=None,
+                   metavar="FRAC",
+                   help="fail unless every serve_trace record's "
+                        "complete/requests fraction >= this (1.0 = every "
+                        "span chain complete under the record's pinned "
+                        "bubble tolerance); fails loudly when no record "
+                        "carries a serve_trace block or it is empty")
+    s.add_argument("--min-windows", type=int, default=None,
+                   help="fail unless the ledger carries at least this many "
+                        "serve_window records (one per closed non-empty "
+                        "telemetry window); fails loudly when telemetry "
+                        "was never enabled")
     s.set_defaults(fn=_serve_report)
 
     lr = sub.add_parser(
@@ -682,6 +881,19 @@ def build_parser() -> argparse.ArgumentParser:
                     help="fail when any record's bubble_frac exceeds this, "
                          "or when no record carries phase_seconds at all")
     tr.set_defaults(fn=_trace_report)
+
+    tl = sub.add_parser(
+        "timeline",
+        help="render serve:trace span records (per-span split, slowest "
+             "requests, SLO attribution, optional Chrome-trace export)",
+    )
+    tl.add_argument("ledger")
+    tl.add_argument("--chrome", default=None, metavar="OUT.json",
+                    help="write the traces as Chrome-trace-event JSON "
+                         "(chrome://tracing / Perfetto)")
+    tl.add_argument("--top", type=int, default=3,
+                    help="print the N slowest requests' full span chains")
+    tl.set_defaults(fn=_timeline)
 
     g = sub.add_parser(
         "robust-gate",

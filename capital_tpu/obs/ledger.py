@@ -799,6 +799,207 @@ def validate_refine_measured(measured) -> list[str]:
     return probs
 
 
+def validate_serve_trace(block) -> list[str]:
+    """Schema problems of one serve_trace block ([] = valid) — the
+    per-request span-chain record `obs.spans.TraceLog.emit` writes.  Same
+    exemption-with-validation posture as request_stats: diff() validates
+    every record carrying the block (malformed -> LedgerIncompatible)
+    while never metric-comparing it — a trace waterfall is a workload's
+    shape; its gates are ``obs serve-report --min-trace-complete`` and the
+    in-run smoke gate.  Chain validation itself delegates to
+    `spans.trace_dict_problems`, the SAME code the producer's `complete`
+    verdict ran, so the ledger check and the in-run gate can never
+    disagree about what a complete chain is."""
+    from capital_tpu.obs import spans
+
+    if not isinstance(block, dict):
+        return [f"serve_trace is {type(block).__name__}, expected object"]
+    probs = []
+    if block.get("schema_version") != SCHEMA_VERSION:
+        probs.append(
+            f"schema_version {block.get('schema_version')!r} != "
+            f"{SCHEMA_VERSION}"
+        )
+    tol = block.get("bubble_tol_ms")
+    if not isinstance(tol, (int, float)) or isinstance(tol, bool) \
+            or not tol >= 0:
+        probs.append(
+            f"bubble_tol_ms must be a non-negative number, got {tol!r}"
+        )
+        tol = spans.DEFAULT_BUBBLE_TOL_MS
+    for key in ("requests", "complete", "dropped", "violations"):
+        v = block.get(key)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            probs.append(f"{key} must be a non-negative int, got {v!r}")
+    traces = block.get("traces")
+    if not isinstance(traces, list):
+        probs.append(f"traces must be a list, got {traces!r}")
+        return probs
+    req = block.get("requests")
+    if isinstance(req, int) and req != len(traces):
+        probs.append(f"requests {req} != len(traces) {len(traces)}")
+    n_complete = 0
+    for i, t in enumerate(traces):
+        t_probs = spans.trace_dict_problems(t, float(tol))
+        if not t_probs:
+            n_complete += 1
+        else:
+            # structural breakage (non-dict / non-numeric spans) is a
+            # schema problem; an INCOMPLETE but well-formed chain is data
+            # the completeness gate judges, not a malformed record
+            for p in t_probs:
+                if ("not a dict" in p or "not a string" in p
+                        or "non-numeric" in p or "not an int" in p
+                        or "not a list" in p):
+                    probs.append(f"traces[{i}]: {p}")
+    comp = block.get("complete")
+    if isinstance(comp, int) and not probs and comp != n_complete:
+        probs.append(
+            f"complete {comp} disagrees with recount {n_complete} under "
+            f"bubble_tol_ms={tol}"
+        )
+    viol = block.get("violations")
+    n_viol = sum(1 for t in traces
+                 if isinstance(t, dict) and t.get("violated"))
+    if isinstance(viol, int) and viol != n_viol:
+        probs.append(f"violations {viol} != recount {n_viol}")
+    return probs
+
+
+def validate_serve_window(block) -> list[str]:
+    """Schema problems of one serve_window block ([] = valid) — a
+    `serve.telemetry.WindowAggregator` closed window.  Same posture as
+    serve_trace: structurally validated on every diff, never
+    metric-compared (a window's latency profile is live traffic; its gate
+    is ``obs serve-report --min-windows``).  Coherence checks pin the
+    invariants the aggregator promises: ok + failed + shed == requests,
+    histogram counts sum to the latencied population, percentiles ordered.
+    A window may legitimately carry requests == 0 with batches > 0 (a
+    batch dispatched in this window whose requests landed in the next),
+    so counts are checked for consistency, never positivity."""
+    if not isinstance(block, dict):
+        return [f"serve_window is {type(block).__name__}, expected object"]
+    probs = []
+    if block.get("schema_version") != SCHEMA_VERSION:
+        probs.append(
+            f"schema_version {block.get('schema_version')!r} != "
+            f"{SCHEMA_VERSION}"
+        )
+    ws = block.get("window_s")
+    if not isinstance(ws, (int, float)) or isinstance(ws, bool) \
+            or not ws > 0:
+        probs.append(f"window_s must be a positive number, got {ws!r}")
+    t0, t1 = block.get("t_start_s"), block.get("t_end_s")
+    for key, v in (("t_start_s", t0), ("t_end_s", t1)):
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            probs.append(f"{key} must be a number, got {v!r}")
+    if (isinstance(t0, (int, float)) and isinstance(t1, (int, float))
+            and t1 < t0):
+        probs.append(f"t_end_s {t1} < t_start_s {t0}")
+    for key in ("requests", "ok", "failed", "shed", "sampled",
+                "queue_depth_max", "batches"):
+        v = block.get(key)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            probs.append(f"{key} must be a non-negative int, got {v!r}")
+    req, ok, failed, shed = (block.get(k)
+                             for k in ("requests", "ok", "failed", "shed"))
+    counted = all(isinstance(v, int) and not isinstance(v, bool)
+                  for v in (req, ok, failed, shed))
+    if counted and ok + failed + shed != req:
+        probs.append(
+            f"ok {ok} + failed {failed} + shed {shed} != requests {req}"
+        )
+    lat = block.get("latency_ms")
+    if not isinstance(lat, dict):
+        probs.append(f"latency_ms must be an object, got {lat!r}")
+    else:
+        for p in _REQ_STATS_PCTS:
+            if not isinstance(lat.get(p), (int, float)):
+                probs.append(f"latency_ms.{p} missing or non-numeric")
+        pcts = [lat.get(p) for p in _REQ_STATS_PCTS]
+        if (all(isinstance(v, (int, float)) for v in pcts)
+                and not pcts[0] <= pcts[1] <= pcts[2]):
+            probs.append(
+                f"percentiles out of order: p50 {pcts[0]} <= p95 "
+                f"{pcts[1]} <= p99 {pcts[2]} fails"
+            )
+    hist = block.get("hist_ms")
+    if not isinstance(hist, dict):
+        probs.append(f"hist_ms must be an object, got {hist!r}")
+    else:
+        edges, counts = hist.get("edges"), hist.get("counts")
+        if (not isinstance(edges, list)
+                or not all(isinstance(e, (int, float)) for e in edges)
+                or sorted(edges) != edges):
+            probs.append(f"hist_ms.edges must be ascending numbers, "
+                         f"got {edges!r}")
+        if (not isinstance(counts, list)
+                or not all(isinstance(c, int) and not isinstance(c, bool)
+                           and c >= 0 for c in counts)):
+            probs.append(f"hist_ms.counts must be non-negative ints, "
+                         f"got {counts!r}")
+        elif isinstance(edges, list) and len(counts) != len(edges) + 1:
+            probs.append(
+                f"hist_ms.counts has {len(counts)} bins for "
+                f"{len(edges)} edges (need len(edges) + 1)"
+            )
+        elif counted and sum(counts) != ok + failed:
+            probs.append(
+                f"hist_ms.counts sum {sum(counts)} != ok + failed "
+                f"{ok + failed}"
+            )
+    sm = block.get("sampled")
+    if (counted and isinstance(sm, int) and not isinstance(sm, bool)
+            and sm > ok + failed):
+        probs.append(f"sampled {sm} > ok + failed {ok + failed}")
+    if not isinstance(block.get("samples_capped"), bool):
+        probs.append(
+            f"samples_capped must be a bool, "
+            f"got {block.get('samples_capped')!r}"
+        )
+    occ = block.get("occupancy_mean")
+    if not isinstance(occ, (int, float)) or isinstance(occ, bool) \
+            or not 0.0 <= occ <= 1.0:
+        probs.append(f"occupancy_mean must be in [0, 1], got {occ!r}")
+    ops = block.get("ops")
+    if not isinstance(ops, dict):
+        probs.append(f"ops must be an object, got {ops!r}")
+    else:
+        for name, v in ops.items():
+            if name not in _REQ_STATS_OPS:
+                probs.append(
+                    f"ops key {name!r} is not a known serve op "
+                    f"{_REQ_STATS_OPS}"
+                )
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                probs.append(
+                    f"ops[{name!r}] must be a non-negative int, got {v!r}"
+                )
+    pb = block.get("per_bucket")
+    if not isinstance(pb, dict):
+        probs.append(f"per_bucket must be an object, got {pb!r}")
+    else:
+        for label, cell in pb.items():
+            if not isinstance(cell, dict):
+                probs.append(f"per_bucket[{label!r}] is not an object")
+                continue
+            for key in ("requests", "shed", "batches"):
+                v = cell.get(key)
+                if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                    probs.append(
+                        f"per_bucket[{label!r}].{key} must be a "
+                        f"non-negative int, got {v!r}"
+                    )
+            co = cell.get("occupancy_mean")
+            if not isinstance(co, (int, float)) or isinstance(co, bool) \
+                    or not 0.0 <= co <= 1.0:
+                probs.append(
+                    f"per_bucket[{label!r}].occupancy_mean must be in "
+                    f"[0, 1], got {co!r}"
+                )
+    return probs
+
+
 def _event_status(rec: dict) -> Optional[str]:
     """The robustness status of a record, when it carries one.
 
@@ -816,6 +1017,12 @@ def _event_status(rec: dict) -> Optional[str]:
     lint_report records (capital_tpu.lint CLI) for the same reason — their
     gate is ``obs lint-report``."""
     if rec.get("request_stats") is not None:
+        return "serve"
+    if rec.get("serve_trace") is not None \
+            or rec.get("serve_window") is not None:
+        # span-chain / rolling-window telemetry records (obs/spans.py,
+        # serve/telemetry.py): same story as request_stats — their gates
+        # are ``obs serve-report --min-trace-complete/--min-windows``
         return "serve"
     if rec.get("lint_report") is not None:
         return "lint"
@@ -861,6 +1068,20 @@ def diff(
             if probs:
                 raise LedgerIncompatible(
                     "malformed request_stats record: " + "; ".join(probs)
+                )
+        st = r.get("serve_trace")
+        if st is not None:
+            probs = validate_serve_trace(st)
+            if probs:
+                raise LedgerIncompatible(
+                    "malformed serve_trace record: " + "; ".join(probs)
+                )
+        sw = r.get("serve_window")
+        if sw is not None:
+            probs = validate_serve_window(sw)
+            if probs:
+                raise LedgerIncompatible(
+                    "malformed serve_window record: " + "; ".join(probs)
                 )
         lr = r.get("lint_report")
         if lr is not None:

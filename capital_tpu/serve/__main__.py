@@ -239,6 +239,38 @@ def _smoke(args) -> int:
             f"--max-compiles {args.max_compiles} (disk tier: {disk}) — the "
             "persistent cache did not cover the workload"
         )
+    if args.trace:
+        # per-request span chains (obs/spans.py): ONE serve:trace record,
+        # gated in-run at 100% completeness under the pinned bubble
+        # tolerance — a request that dropped a stamping site, stamped out
+        # of order, or opened an un-spanned gap fails the smoke here, not
+        # three tools later
+        from capital_tpu.obs import spans
+
+        trec = eng.emit_trace(args.ledger, bubble_tol_ms=args.bubble_tol_ms)
+        st = trec["serve_trace"]
+        print(
+            f"# serve-smoke: traced {st['requests']} requests, "
+            f"{st['complete']} complete chains "
+            f"(bubble_tol_ms={st['bubble_tol_ms']}, "
+            f"dropped={st['dropped']})"
+        )
+        if st["requests"] != len(tickets):
+            failures.append(
+                f"trace gate: {st['requests']} traced requests != "
+                f"{len(tickets)} submitted — a request slipped through "
+                "untraced"
+            )
+        if st["complete"] != st["requests"] or st["dropped"]:
+            for t in st["traces"]:
+                for pb in spans.trace_dict_problems(
+                        t, st["bubble_tol_ms"]):
+                    print(f"#   trace {t['request_id']}: {pb}",
+                          file=sys.stderr)
+            failures.append(
+                f"trace gate: {st['complete']}/{st['requests']} complete "
+                f"span chains (dropped={st['dropped']}) — need 100%"
+            )
     for f in failures:
         print(f"# serve-smoke FAIL: {f}", file=sys.stderr)
     if failures:
@@ -464,6 +496,12 @@ def _loadgen_replicas(args) -> int:
 
 def _loadgen(args) -> int:
     if args.replicas:
+        if (args.window_s or args.trace or args.min_windows is not None
+                or args.deadline_ms is not None):
+            print("loadgen: --window-s/--min-windows/--deadline-ms/--trace "
+                  "are not supported with --replicas (use the single-"
+                  "engine A/B, or `smoke --trace`)", file=sys.stderr)
+            return 2
         return _loadgen_replicas(args)
 
     from capital_tpu.serve import loadgen
@@ -481,20 +519,34 @@ def _loadgen(args) -> int:
     )
     wl = loadgen.Workload(
         requests=args.requests, concurrency=args.concurrency,
-        seed=args.seed, dtype=args.dtype,
+        seed=args.seed, dtype=args.dtype, deadline_ms=args.deadline_ms,
     )
-    results = loadgen.compare(cfg, wl, ledger_path=args.ledger)
+    results = loadgen.compare(cfg, wl, ledger_path=args.ledger,
+                              window_s=args.window_s, trace=args.trace)
     failures = []
+    nwin = 0
     for mode in ("sync", "continuous"):
         res = results.get(mode)
         if res is None:
             continue
         cache = res["cache"]
+        win_note = ""
+        if args.window_s:
+            nwin += res.get("window_records", 0)
+            win_note = f", windows {res.get('window_records', 0)}"
+        trace_note = ""
+        if args.trace:
+            st = res["trace_record"]["serve_trace"]
+            trace_note = (f", traces {st['complete']}/{st['requests']} "
+                          f"complete")
+            if args.deadline_ms is not None:
+                trace_note += f", SLO violations {st['violations']}"
         print(
             f"# serve-loadgen {mode}: {res['requests']} requests in "
             f"{res['wall_s']:.3f}s = {res['qps']:.1f} qps "
             f"(concurrency {wl.concurrency}, cache misses "
-            f"{cache['misses']}, compiles {cache['compiles']})"
+            f"{cache['misses']}, compiles {cache['compiles']}"
+            + win_note + trace_note + ")"
         )
         if res["failed"]:
             failures.append(f"{mode}: {res['failed']} requests failed")
@@ -502,6 +554,20 @@ def _loadgen(args) -> int:
             failures.append(
                 f"{mode}: {cache['misses']} steady-state recompiles "
                 "(warmup must cover the workload grid)"
+            )
+    if args.min_windows is not None:
+        # loud-when-dead: asking for a window floor without enabling the
+        # telemetry that produces windows is a wiring bug, not a pass
+        if not args.window_s:
+            failures.append(
+                "--min-windows requires --window-s (telemetry disabled, "
+                "no windows can ever close)"
+            )
+        elif nwin < args.min_windows:
+            failures.append(
+                f"{nwin} serve:window record(s) across modes < "
+                f"--min-windows {args.min_windows} (run longer, or "
+                "shrink --window-s)"
             )
     if results.get("speedup") is not None:
         print(f"# serve-loadgen: continuous/sync speedup "
@@ -542,6 +608,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="fail if more than this many fresh XLA compiles "
                         "happened (0 on a warm --persist-dir = the "
                         "cold-start proof)")
+    s.add_argument("--trace", action="store_true",
+                   help="emit the per-request span-chain record "
+                        "(serve:trace, obs/spans.py) and gate the run on "
+                        "100%% complete monotonic chains")
+    s.add_argument("--bubble-tol-ms", type=float, default=25.0,
+                   help="largest un-spanned host-side gap a chain may "
+                        "carry and still count complete "
+                        "(spans.DEFAULT_BUBBLE_TOL_MS)")
     s.set_defaults(fn=_smoke)
     g = sub.add_parser(
         "loadgen",
@@ -563,6 +637,22 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--min-speedup", type=float, default=None,
                    help="fail if continuous/sync QPS falls below this "
                         "(leave unset on shared CI hardware)")
+    g.add_argument("--window-s", type=float, default=None,
+                   help="enable rolling-window telemetry "
+                        "(serve/telemetry.py) with this window length; "
+                        "appends one serve:window record per closed "
+                        "non-empty window")
+    g.add_argument("--min-windows", type=int, default=None,
+                   help="fail unless at least this many serve:window "
+                        "records were emitted across both modes "
+                        "(requires --window-s)")
+    g.add_argument("--deadline-ms", type=float, default=None,
+                   help="per-request SLO deadline: traces carry "
+                        "slack-at-dispatch and violation attribution "
+                        "(most useful with --trace)")
+    g.add_argument("--trace", action="store_true",
+                   help="emit one serve:trace span-chain record per mode "
+                        "(not supported with --replicas)")
     g.add_argument("--replicas", type=int, default=0,
                    help="run the replica-count A/B instead: 1 vs N "
                         "replicas behind a router at equal per-client "

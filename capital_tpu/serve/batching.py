@@ -70,6 +70,21 @@ class Bucket:
                 self.capacity, self.tier)
 
 
+def bucket_label(bucket) -> str:
+    """Compact human-stable bucket name for span/telemetry tags and
+    chrome-trace args, e.g. ``posv/f32/a256x256/b256x8/c8`` — the key's
+    information without tuple-repr noise (and JSON-safe).  Accepts a
+    Bucket or its `.key` tuple (the form Responses/stats carry)."""
+    if isinstance(bucket, tuple):
+        bucket = Bucket(*bucket)
+    a = "x".join(str(d) for d in bucket.a_shape)
+    b = ("" if bucket.b_shape is None
+         else "/b" + "x".join(str(d) for d in bucket.b_shape))
+    tier = "" if bucket.tier == "balanced" else f"/{bucket.tier}"
+    dt = str(bucket.dtype).replace("float", "f").replace("bfloat", "bf")
+    return f"{bucket.op}/{dt}/a{a}{b}/c{bucket.capacity}{tier}"
+
+
 def _pick(ladder: tuple[int, ...], v: int) -> int | None:
     """Smallest ladder rung >= v, or None (oversize)."""
     best = None

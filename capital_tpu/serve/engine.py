@@ -48,6 +48,7 @@ import jax
 import jax.numpy as jnp
 
 from capital_tpu.models import blocktri
+from capital_tpu.obs import spans
 from capital_tpu.ops import batched_small, blocktri_small, lapack, update_small
 from capital_tpu.parallel.topology import Grid
 from capital_tpu.robust import faultinject
@@ -215,6 +216,14 @@ class SolveEngine:
         self.factors = FactorCache(cfg.factor_cache_bytes)
         self.executor = Executor(cfg, self.grid, self.stats)
         self.scheduler = Scheduler(cfg, self.executor, self._resolve_bucket)
+        # per-request span traces (obs/spans.py): every submit() starts a
+        # RequestTrace; the serve path stamps it host-side as the request
+        # moves.  Bounded (oldest dropped, counted) — emit_trace() exports
+        # the run's chains as one serve:trace record.
+        self.trace_log = spans.TraceLog()
+        # rolling-window live telemetry (serve/telemetry.py): None until
+        # enable_telemetry() attaches an aggregator to the stats tap.
+        self.telemetry = None
         self._next_id = 0
         # the device batched executables run on — staging target.  The
         # bucket programs are single-device (jit, no sharding); oversize
@@ -418,7 +427,8 @@ class SolveEngine:
 
     def submit(self, op: str, A, B=None, *,
                factor_token: Optional[str] = None,
-               accuracy_tier: str = "balanced") -> Ticket:
+               accuracy_tier: str = "balanced",
+               deadline_ms: Optional[float] = None) -> Ticket:
         """Enqueue one solve request; returns a Ticket that resolves when
         its batch lands.  A capacity-full bucket DISPATCHES inside this
         call; under the continuous scheduler the dispatch is issued
@@ -444,11 +454,19 @@ class SolveEngine:
         blocktri_extend submits the appended chain packing
         A = (2, nblocks, b, b) — a never-seen token seeds a fresh chain
         (C[:, 0] zeroed host-side), an EVICTED token fails loudly (a
-        silently re-seeded chain would be a wrong answer)."""
+        silently re-seeded chain would be a wrong answer).
+
+        `deadline_ms` is a per-request latency SLO (relative to submit
+        entry).  It never changes scheduling today — it stamps the
+        request's trace so the serve:trace record carries
+        slack-at-dispatch and, on violation, which span ate the budget
+        (docs/SERVING.md 'Deadlines and SLO attribution')."""
         t_enq = time.monotonic()
         tid = self._next_id
         self._next_id += 1
         ticket = Ticket(tid, t_enq)
+        ticket.deadline_ms = (float(deadline_ms)
+                              if deadline_ms is not None else None)
         A = jnp.asarray(A)
         B = jnp.asarray(B) if B is not None else None
         if op not in batching.OPS:
@@ -514,6 +532,10 @@ class SolveEngine:
             raise ValueError(f"{op} needs a square SPD operand, got {A.shape}")
         if op == "lstsq" and A.shape[0] < A.shape[1]:
             raise ValueError(f"lstsq expects tall input, got {A.shape}")
+        # trace starts AFTER the raise-validation above: a rejected call
+        # never entered the serve path, so no orphan chain may pollute
+        # the 100%-complete trace gate
+        self._start_trace(ticket, op, accuracy_tier)
         try:
             # HOST-side per-request fault tap on the concrete operand:
             # deterministic per submit() occurrence, and — critically —
@@ -586,10 +608,12 @@ class SolveEngine:
 
     def solve(self, op: str, A, B=None, *,
               factor_token: Optional[str] = None,
-              accuracy_tier: str = "balanced") -> Response:
+              accuracy_tier: str = "balanced",
+              deadline_ms: Optional[float] = None) -> Response:
         """Convenience synchronous path: submit + drain + result."""
         ticket = self.submit(op, A, B, factor_token=factor_token,
-                             accuracy_tier=accuracy_tier)
+                             accuracy_tier=accuracy_tier,
+                             deadline_ms=deadline_ms)
         if not ticket.done:
             self.drain()
         return ticket.result()
@@ -605,6 +629,43 @@ class SolveEngine:
             cache=self.cache_stats(), factor_cache=self.factors.stats(),
             **extra,
         )
+
+    def emit_trace(self, path: Optional[str] = None, *,
+                   bubble_tol_ms: float = spans.DEFAULT_BUBBLE_TOL_MS,
+                   **extra) -> dict:
+        """Export the run's span chains as one serve:trace ledger record
+        (appended to `path` when given) — the per-request counterpart of
+        emit_stats()."""
+        return self.trace_log.emit(
+            path, grid=self.grid, config=self.cfg,
+            bubble_tol_ms=bubble_tol_ms, **extra,
+        )
+
+    def enable_telemetry(self, window_s: float = 1.0, *,
+                         sample_cap: Optional[int] = None):
+        """Attach a rolling-window aggregator (serve/telemetry.py) to the
+        stats tap: every request/batch/queue-depth note also lands in the
+        current time window, and `self.telemetry.emit(path)` appends one
+        serve:window record per closed window.  Host-side counters only —
+        never part of the config hash, never a compiled program's
+        concern.  Returns the aggregator."""
+        from capital_tpu.serve import telemetry
+
+        kw = {} if sample_cap is None else {"sample_cap": sample_cap}
+        self.telemetry = telemetry.WindowAggregator(window_s, **kw)
+        self.stats.window = self.telemetry
+        return self.telemetry
+
+    def _start_trace(self, ticket: Ticket, op: str,
+                     tier: str) -> spans.RequestTrace:
+        tr = self.trace_log.start(
+            ticket.request_id, op, ticket.t_enq,
+            deadline_ms=ticket.deadline_ms,
+            tier=tier, cfg_hash=self._cfg_hash,
+            replica_id=self.stats.replica_id,
+        )
+        ticket.trace = tr
+        return tr
 
     # ---- factor residency (docs/SERVING.md "Factor residency") -------------
 
@@ -653,6 +714,13 @@ class SolveEngine:
                 pa = jax.device_put(pa, self._stage_device)
                 if pb is not None:
                     pb = jax.device_put(pb, self._stage_device)
+        if ticket.trace is not None:
+            # admit covers validation + fault tap + pad + stage; stamped
+            # BEFORE scheduler.admit because a capacity flush dispatches
+            # synchronously inside it (the enqueue span must start here)
+            ticket.trace.tag(bucket=batching.bucket_label(bucket),
+                             tier=bucket.tier)
+            ticket.trace.extend("admit")
         self.scheduler.admit(bucket, _Pending(
             ticket, pa, pb, a_shape, b_shape, t_enq,
             client_op=client_op, sink=sink,
@@ -700,6 +768,9 @@ class SolveEngine:
                     f"blocktri_extend takes no B (the resident carry is "
                     f"the second operand), got B {B.shape}"
                 )
+        # same discipline as submit(): trace only once the request is past
+        # the raise-validation and actually inside the serve path
+        self._start_trace(ticket, op, "balanced")
         try:
             # same host-side per-request tap as submit(): a planted fault
             # corrupts exactly one request's operand and never bakes into
@@ -1007,8 +1078,16 @@ class SolveEngine:
 
     def _run_single(self, ticket: Ticket, op: str, A, B,
                     t_enq: float) -> None:
+        tr = ticket.trace
+        if tr is not None:
+            # oversize singles never queue or batch: the chain collapses
+            # to admit -> cache_lookup -> device -> respond
+            tr.kind = "single"
+            tr.extend("admit")
         a_sds = jax.ShapeDtypeStruct(A.shape, A.dtype)
         b_sds = (jax.ShapeDtypeStruct(B.shape, B.dtype)
                  if B is not None else None)
         exe = self._get_single(op, a_sds, b_sds)
+        if tr is not None:
+            tr.extend("cache_lookup")
         self.executor.run_single(ticket, op, A, B, exe, t_enq)

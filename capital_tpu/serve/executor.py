@@ -41,6 +41,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from capital_tpu.obs import spans
 from capital_tpu.robust.config import RobustInfo
 from capital_tpu.serve import batching
 from capital_tpu.utils import tracing
@@ -66,6 +67,7 @@ class Response:
     latency_s: float
     queue_wait_s: Optional[float] = None
     device_s: Optional[float] = None
+    trace: Optional[spans.RequestTrace] = None
 
 
 class Ticket:
@@ -76,13 +78,16 @@ class Ticket:
     flight and will materialize), and `result()` lands the batch on demand
     if `pump()`/`drain()` hasn't already."""
 
-    __slots__ = ("request_id", "t_enq", "t0", "response", "_entry", "_land")
+    __slots__ = ("request_id", "t_enq", "t0", "response", "trace",
+                 "deadline_ms", "_entry", "_land")
 
     def __init__(self, request_id: int, t_enq: float = 0.0):
         self.request_id = request_id
         self.t_enq = t_enq
         self.t0: Optional[float] = None  # stamped at dispatch
         self.response: Optional[Response] = None
+        self.trace: Optional[spans.RequestTrace] = None
+        self.deadline_ms: Optional[float] = None
         self._entry = None  # InFlight carrying this ticket, once dispatched
         self._land = None  # scheduler callback that lands _entry
 
@@ -202,7 +207,10 @@ class Executor:
                       t0=t0, small=small)
         for p in pending:
             p.ticket.t0 = t0
-        self.stats.note_batch(occupancy)
+            if p.ticket.trace is not None:
+                # assemble + async invoke issue; host-side stamp only
+                p.ticket.trace.extend("batch_form", t0)
+        self.stats.note_batch(occupancy, bucket=batching.bucket_label(bucket))
         return fl
 
     def ready(self, fl: InFlight) -> bool:
@@ -231,11 +239,16 @@ class Executor:
         *xs, info = jax.block_until_ready(fl.outputs)
         t_land = time.monotonic()
         for i, p in enumerate(fl.pending):
+            tr = p.ticket.trace
+            if tr is not None:
+                tr.extend("device", t_land)
             xi = batching.crop(fl.bucket.op, xs[0][i], p.a_shape, p.b_shape)
             ri = info[i]
             err = None
             if p.sink is not None:
                 xi, ri, err = p.sink(xi, tuple(x[i] for x in xs[1:]), ri)
+                if tr is not None:
+                    tr.extend("refine")  # sink bookkeeping ran host-side
             op = p.client_op or fl.bucket.op
             if err is not None:
                 # the sink refused the result (double-failed downdate
@@ -248,8 +261,13 @@ class Executor:
                     bucket=fl.bucket.key, batched=True, latency_s=lat,
                     queue_wait_s=max(0.0, fl.t0 - p.t_enq),
                     device_s=max(0.0, t_land - fl.t0),
+                    trace=tr,
                 )
-                self.stats.record_request(op, lat, ok=False, failed=True)
+                if tr is not None:
+                    tr.extend("respond")
+                self.stats.record_request(
+                    op, lat, ok=False, failed=True,
+                    bucket=batching.bucket_label(fl.bucket))
                 continue
             self._finish(
                 p.ticket, op, xi, ri, fl.bucket.key,
@@ -271,8 +289,11 @@ class Executor:
         ticket.t0 = t0
         x, raw = exe(A) if B is None else exe(A, B)
         x, raw = jax.block_until_ready((x, raw))
+        t_land = time.monotonic()
+        if ticket.trace is not None:
+            ticket.trace.extend("device", t_land)
         self._finish(ticket, op, x, raw, None, batched=False, t_enq=t_enq,
-                     t0=t0, t_land=time.monotonic())
+                     t0=t0, t_land=t_land)
 
     # ---- landing internals -------------------------------------------------
 
@@ -280,11 +301,20 @@ class Executor:
              t_enq: float) -> None:
         """Land a request that never reached a device: ingest fault or
         oversize-reject.  No queue-wait/device split exists for it."""
-        lat = time.monotonic() - t_enq
+        now = time.monotonic()
+        lat = now - t_enq
+        tr = ticket.trace
+        if tr is not None:
+            # collapse to the failed chain: admit covers submit-to-fault,
+            # respond is the Response/stats stamp happening right here
+            tr.kind = "failed"
+            if not tr.spans:
+                tr.extend("admit", now)
+            tr.extend("respond")
         ticket.response = Response(
             request_id=ticket.request_id, op=op, ok=False, x=None,
             info=None, error=error, bucket=None, batched=False,
-            latency_s=lat,
+            latency_s=lat, trace=tr,
         )
         self.stats.record_request(op, lat, ok=False, failed=True)
 
@@ -316,9 +346,14 @@ class Executor:
             error=None, bucket=bucket_key, batched=batched,
             latency_s=t_land - t_enq,
             queue_wait_s=queue_wait, device_s=device,
+            trace=ticket.trace,
         )
+        if ticket.trace is not None:
+            ticket.trace.extend("respond")
         self.stats.record_request(
             op, t_land - t_enq, ok=ok,
             flagged=(info is not None and not ok), small=small,
             queue_wait_s=queue_wait, device_s=device,
+            bucket=(batching.bucket_label(bucket_key)
+                    if bucket_key is not None else None),
         )

@@ -60,6 +60,10 @@ class Workload:
     ns: tuple[int, ...] = (16, 32, 64)
     nrhs: tuple[int, ...] = (1, 4)
     dtype: str = "float32"
+    #: per-request latency SLO stamped onto every submit (None = no SLO):
+    #: the serve:trace record then carries slack-at-dispatch and
+    #: violation attribution per request
+    deadline_ms: Optional[float] = None
 
 
 def build_requests(wl: Workload) -> list[tuple]:
@@ -100,12 +104,14 @@ def warmup_specs(wl: Workload) -> list[tuple]:
     return specs
 
 
-def run_closed_loop(eng, requests: list[tuple],
-                    concurrency: int) -> dict:
+def run_closed_loop(eng, requests: list[tuple], concurrency: int,
+                    deadline_ms: Optional[float] = None) -> dict:
     """Drive one engine to completion over `requests` with at most
     `concurrency` clients outstanding.  A client's slot frees when its
     Response LANDS (not merely when its batch dispatches) — that is the
-    closed loop.  Returns wall-clock QPS and completion counts."""
+    closed loop.  `deadline_ms` stamps the per-request SLO onto every
+    submit (trace attribution; scheduling is unchanged).  Returns
+    wall-clock QPS and completion counts."""
     todo = list(requests)
     todo.reverse()  # pop() from the tail preserves workload order
     outstanding: list = []
@@ -115,7 +121,8 @@ def run_closed_loop(eng, requests: list[tuple],
         progressed = False
         while todo and len(outstanding) < concurrency:
             op, A, B = todo.pop()
-            outstanding.append(eng.submit(op, A, B))
+            outstanding.append(eng.submit(op, A, B,
+                                          deadline_ms=deadline_ms))
             progressed = True
         eng.pump()
         still = []
@@ -158,11 +165,19 @@ def _mk_engine(cfg, scheduler: str, grid=None):
 
 def compare(cfg: ServeConfig, wl: Workload = Workload(), *, grid=None,
             ledger_path: Optional[str] = None,
-            modes: tuple[str, ...] = ("sync", "continuous")) -> dict:
+            modes: tuple[str, ...] = ("sync", "continuous"),
+            window_s: Optional[float] = None,
+            trace: bool = False) -> dict:
     """The A/B harness: run the same workload through each scheduler mode
     (fresh engine each, shared ServeConfig otherwise — including
     persist_dir, which both may share safely), emit one ledger record per
     mode, and return {mode: results, 'speedup': continuous_qps/sync_qps}.
+
+    `window_s` attaches rolling-window telemetry to each mode's engine
+    and appends one serve:window record per closed window; `trace`
+    appends one serve:trace record per mode carrying every request's
+    span chain.  Both default off, so pre-existing ledger contents stay
+    byte-compatible.
 
     The sync mode runs first so a cold persist_dir is warm for the
     continuous run in the same way a restart would see it; with warmup()
@@ -174,8 +189,11 @@ def compare(cfg: ServeConfig, wl: Workload = Workload(), *, grid=None,
     records: dict = {}
     for mode in modes:
         eng = _mk_engine(cfg, mode, grid)
+        if window_s:
+            eng.enable_telemetry(window_s)
         eng.warmup(specs)
-        results[mode] = run_closed_loop(eng, requests, wl.concurrency)
+        results[mode] = run_closed_loop(eng, requests, wl.concurrency,
+                                        deadline_ms=wl.deadline_ms)
         results[mode]["cache"] = eng.cache_stats()
         records[mode] = (eng, results[mode])
     speedup = None
@@ -196,6 +214,14 @@ def compare(cfg: ServeConfig, wl: Workload = Workload(), *, grid=None,
             block["baseline_qps"] = results["sync"]["qps"]
             block["speedup"] = speedup
         res["record"] = eng.emit_stats(ledger_path, loadgen=block)
+        if eng.telemetry is not None:
+            wrecs = eng.telemetry.emit(ledger_path, grid=eng.grid,
+                                       config=eng.cfg,
+                                       loadgen={"mode": mode})
+            res["window_records"] = len(wrecs)
+        if trace:
+            res["trace_record"] = eng.emit_trace(
+                ledger_path, loadgen={"mode": mode})
     return results
 
 

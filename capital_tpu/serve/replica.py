@@ -80,6 +80,9 @@ class Result:
     queue_wait_s: Optional[float] = None
     device_s: Optional[float] = None
     replica_id: Optional[str] = None
+    #: the request's exported span chain (spans.RequestTrace.asdict() —
+    #: plain JSON-safe dict, so it crosses the pipe transport freely)
+    trace: Optional[dict] = None
 
 
 def _marshal(rid: int, resp) -> dict:
@@ -89,6 +92,9 @@ def _marshal(rid: int, resp) -> dict:
     info = resp.info
     if info is not None and dataclasses.is_dataclass(info):
         info = dataclasses.asdict(info)
+    trace = getattr(resp, "trace", None)
+    if trace is not None:
+        trace = dict(trace.asdict(), request_id=rid)
     return {
         "request_id": rid,
         "op": resp.op,
@@ -101,6 +107,7 @@ def _marshal(rid: int, resp) -> dict:
         "latency_s": float(resp.latency_s),
         "queue_wait_s": resp.queue_wait_s,
         "device_s": resp.device_s,
+        "trace": trace,
     }
 
 
@@ -136,20 +143,24 @@ def _serve_loop(replica_id: str, cfg_kwargs: dict,
         """Apply one inbox message; True means exit the loop."""
         kind = msg[0]
         if kind == "submit":
-            # 5-tuple is the pre-tier wire format; a trailing element is
-            # the accuracy_tier (sent only when non-balanced, so mixed
-            # router/replica versions interoperate on balanced traffic)
+            # 5-tuple is the pre-tier wire format; trailing elements are
+            # [tier] or [tier, deadline_ms] (tier sent explicitly — even
+            # "balanced" — whenever a deadline rides along, so mixed
+            # router/replica versions interoperate on plain traffic)
             _, rid, op, A, B, *rest = msg
             tier = rest[0] if rest else "balanced"
+            deadline = rest[1] if len(rest) > 1 else None
             try:
                 outstanding[rid] = eng.submit(op, A, B,
-                                              accuracy_tier=tier)
+                                              accuracy_tier=tier,
+                                              deadline_ms=deadline)
             except ValueError as e:
                 send(("result", rid, {
                     "request_id": rid, "op": op, "ok": False, "x": None,
                     "info": None, "error": f"{type(e).__name__}: {e}",
                     "bucket": None, "batched": False, "latency_s": 0.0,
                     "queue_wait_s": None, "device_s": None,
+                    "trace": None,
                 }))
         elif kind == "warmup":
             fresh = eng.warmup(msg[2])
@@ -259,10 +270,15 @@ class EngineReplica:
         }
 
     def submit(self, rid: int, op: str, A, B=None,
-               tier: str = "balanced") -> None:
+               tier: str = "balanced",
+               deadline_ms: Optional[float] = None) -> None:
         msg = ("submit", rid, op, np.asarray(A),
                np.asarray(B) if B is not None else None)
-        if tier != "balanced":
+        if deadline_ms is not None:
+            # deadline rides after the tier, so the tier goes on the wire
+            # explicitly (even "balanced") whenever a deadline does
+            msg = msg + (tier, float(deadline_ms))
+        elif tier != "balanced":
             # trailing element only when non-balanced: balanced traffic
             # keeps the pre-tier 5-tuple wire format
             msg = msg + (tier,)

@@ -44,6 +44,7 @@ from typing import Optional
 
 import numpy as np
 
+from capital_tpu.obs import spans
 from capital_tpu.serve.replica import EngineReplica, Result
 
 POLICIES = ("least_loaded", "bucket_affinity")
@@ -73,16 +74,18 @@ class RouterTicket:
     so a replica death can re-dispatch the request — the router's no-drop
     contract is exactly this copy."""
 
-    __slots__ = ("request_id", "op", "A", "B", "tier", "t_enq",
-                 "replica_id", "attempts", "response", "_event")
+    __slots__ = ("request_id", "op", "A", "B", "tier", "deadline_ms",
+                 "t_enq", "replica_id", "attempts", "response", "_event")
 
     def __init__(self, request_id: int, op: str, A, B,
-                 tier: str = "balanced"):
+                 tier: str = "balanced",
+                 deadline_ms: Optional[float] = None):
         self.request_id = request_id
         self.op = op
         self.A = A
         self.B = B
         self.tier = tier
+        self.deadline_ms = deadline_ms
         self.t_enq = time.monotonic()
         self.replica_id: Optional[str] = None  # current owner
         self.attempts = 0
@@ -194,6 +197,10 @@ class Router:
         self.redispatched = 0  # re-sends after a replica failure
         self.duplicates = 0  # crash-race second results, dropped
         self.failed_replicas = 0
+        # exported span chains from every landed Result (spans.py is pure
+        # Python — no jax enters this host-only module); emit_stats adds a
+        # serve:trace record when any rode back
+        self.trace_log = spans.TraceLog()
 
     # ---- membership --------------------------------------------------------
 
@@ -225,7 +232,8 @@ class Router:
     # ---- client surface ----------------------------------------------------
 
     def submit(self, op: str, A, B=None, *,
-               accuracy_tier: str = "balanced") -> RouterTicket:
+               accuracy_tier: str = "balanced",
+               deadline_ms: Optional[float] = None) -> RouterTicket:
         """Dispatch one request to a healthy replica; raises RuntimeError
         when none admits (every replica dead or draining) — admission
         control, not silent queueing.  Work already admitted is never
@@ -239,7 +247,7 @@ class Router:
             self._next_id += 1
             t = RouterTicket(rid, op, np.asarray(A),
                              np.asarray(B) if B is not None else None,
-                             tier=accuracy_tier)
+                             tier=accuracy_tier, deadline_ms=deadline_ms)
             st = self._pick(t)
             if st is None:
                 raise RuntimeError(
@@ -435,6 +443,14 @@ class Router:
                 ledger.append(path, rec)
         return recs
 
+    def emit_trace(self, path: Optional[str] = None, **extra) -> dict:
+        """One serve:trace record covering every trace the replicas
+        marshalled back (replica-tagged span chains) — the multi-replica
+        counterpart of SolveEngine.emit_trace.  Kept separate from
+        emit_stats so consumers iterating its request_stats records never
+        meet a foreign record kind."""
+        return self.trace_log.emit(path, config=self.cfg, **extra)
+
     # ---- internals ---------------------------------------------------------
 
     def _healthy(self) -> list[_ReplicaState]:
@@ -464,7 +480,7 @@ class Router:
         while True:
             try:
                 st.replica.submit(t.request_id, t.op, t.A, t.B,
-                                  tier=t.tier)
+                                  tier=t.tier, deadline_ms=t.deadline_ms)
             except OSError:
                 self._fail_replica(st)
                 nxt = self._pick(t)
@@ -502,6 +518,13 @@ class Router:
             self.duplicates += 1
             return 0
         t.response = Result(**payload, replica_id=st.replica.replica_id)
+        trace = payload.get("trace")
+        if trace is not None:
+            # the replica's engine tagged its own replica_id; keep it
+            # authoritative but fill it in when absent (older payloads)
+            if not trace.get("replica_id"):
+                trace = dict(trace, replica_id=st.replica.replica_id)
+            self.trace_log.add(trace)
         t._event.set()
         st.completed += 1
         self.completed += 1

@@ -39,6 +39,7 @@ requests never enter the scheduler.
 
 from __future__ import annotations
 
+import time
 from collections import deque
 from typing import Callable, Optional
 
@@ -84,7 +85,17 @@ class Scheduler:
         q = self._queues.pop(bucket, [])
         if not q:
             return False
+        t_form = time.monotonic()
         exe, small = self._get_exe(bucket)
+        t_exe = time.monotonic()
+        for p in q:
+            if p.ticket.trace is not None:
+                # enqueue = parked in the bucket queue until this flush;
+                # cache_lookup = executable resolution (a compile lands
+                # its full cost HERE — the attribution the zero-recompile
+                # gates key on)
+                p.ticket.trace.extend("enqueue", t_form)
+                p.ticket.trace.extend("cache_lookup", t_exe)
         fl = self.executor.dispatch(bucket, exe, q, small)
         if self.cfg.scheduler == "sync":
             self.executor.land(fl)

@@ -15,16 +15,66 @@ record and a bench row read on one scale.
 
 from __future__ import annotations
 
+import random
 from collections import Counter
 
 from capital_tpu.bench.harness import percentiles
+
+#: Default bound on each raw-sample population a Collector retains.  A
+#: long-running replica records forever; without a cap its four latency
+#: lists grow without limit.  High enough that every tier-1 smoke and
+#: loadgen run stays exact (capped == False).
+DEFAULT_SAMPLE_CAP = 8192
+
+
+class Reservoir:
+    """Bounded sample population: the first `cap` values verbatim, then
+    uniform reservoir replacement (algorithm R) with a deterministic
+    per-instance seed — two replicas under identical traffic snapshot
+    identical populations.  Iterable/len-able so `percentiles(reservoir)`
+    and `list(reservoir)` read like the list it replaces; `count` is the
+    TRUE number of values ever recorded and `capped` says whether the
+    population is a subsample (the signal merge_snapshots degrades on)."""
+
+    __slots__ = ("cap", "count", "_items", "_rng")
+
+    def __init__(self, cap: int = DEFAULT_SAMPLE_CAP):
+        if cap < 1:
+            raise ValueError(f"reservoir cap must be >= 1, got {cap}")
+        self.cap = cap
+        self.count = 0
+        self._items: list[float] = []
+        self._rng = random.Random(0x5EED)
+
+    def append(self, v: float) -> None:
+        self.count += 1
+        if len(self._items) < self.cap:
+            self._items.append(v)
+            return
+        j = self._rng.randrange(self.count)
+        if j < self.cap:
+            self._items[j] = v
+
+    @property
+    def capped(self) -> bool:
+        return self.count > self.cap
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def __iter__(self):
+        return iter(self._items)
+
+    def __bool__(self) -> bool:
+        return bool(self._items)
 
 
 class Collector:
     """Accumulates serving telemetry; snapshot() emits the request_stats
     block documented in docs/SERVING.md."""
 
-    def __init__(self, replica_id: str | None = None):
+    def __init__(self, replica_id: str | None = None,
+                 sample_cap: int = DEFAULT_SAMPLE_CAP):
         # multi-replica deployments tag each collector with its replica's
         # id so the router / `obs serve-report --aggregate` can tell the
         # per-replica records apart (docs/SERVING.md "Multi-replica
@@ -36,7 +86,11 @@ class Collector:
         self.flagged = 0  # robust-flagged (breakdown detected, result kept)
         self.failed = 0  # no result at all (ingest fault / rejected)
         self.ops: Counter = Counter()
-        self.latencies_s: list[float] = []
+        # every raw-sample population is reservoir-capped (Reservoir) so a
+        # long-running replica's memory stays bounded; counts stay exact,
+        # percentiles degrade to a uniform subsample past the cap and the
+        # snapshot says so (samples_capped).
+        self.latencies_s = Reservoir(sample_cap)
         self.queue_depth_max = 0
         self.batches = 0
         self.occupancies: list[float] = []
@@ -44,15 +98,20 @@ class Collector:
         # their own latency population so `obs serve-report` can gate
         # small-bucket p99 (--max-p99-ms-small) separately from the large
         # buckets, whose solve time dominates any mixed percentile.
-        self.latencies_small_s: list[float] = []
+        self.latencies_small_s = Reservoir(sample_cap)
         # the two halves of each dispatched request's latency (executor
         # timing contract): queue-wait is scheduling policy, device is
         # compute + transfer.  Separate populations (not per-request pairs)
         # because the report gates each tail independently
         # (--max-queue-wait-ms); requests that never dispatched (ingest
         # faults, rejects) contribute to neither.
-        self.queue_waits_s: list[float] = []
-        self.devices_s: list[float] = []
+        self.queue_waits_s = Reservoir(sample_cap)
+        self.devices_s = Reservoir(sample_cap)
+        # optional live-telemetry tap (serve/telemetry.WindowAggregator,
+        # attached by SolveEngine.enable_telemetry): every record/note
+        # forwards, so the rolling windows see exactly what the snapshot
+        # sees.  None (the default) adds one attribute check per note.
+        self.window = None
         # posv_blocktri algorithm split ('scan' vs 'partitioned' — which
         # chain driver the request's compiled program runs, resolved by
         # the engine at submit time from static geometry).  Optional
@@ -86,15 +145,20 @@ class Collector:
 
     def note_queue_depth(self, depth: int) -> None:
         self.queue_depth_max = max(self.queue_depth_max, depth)
+        if self.window is not None:
+            self.window.note_queue_depth(depth)
 
-    def note_batch(self, occupancy: float) -> None:
+    def note_batch(self, occupancy: float, bucket=None) -> None:
         self.batches += 1
         self.occupancies.append(occupancy)
+        if self.window is not None:
+            self.window.note_batch(occupancy, bucket=bucket)
 
     def record_request(
         self, op: str, latency_s: float, ok: bool,
         flagged: bool = False, failed: bool = False, small: bool = False,
         queue_wait_s: float | None = None, device_s: float | None = None,
+        bucket=None,
     ) -> None:
         self.requests += 1
         self.ops[op] += 1
@@ -111,6 +175,9 @@ class Collector:
             self.flagged += 1
         elif ok:
             self.ok += 1
+        if self.window is not None:
+            self.window.note_request(op, latency_s, ok=ok, failed=failed,
+                                     bucket=bucket)
 
     # ---- reporting ---------------------------------------------------------
 
@@ -161,7 +228,7 @@ class Collector:
         # so pre-existing records (and engines that never route pallas)
         # keep the exact schema they always had.
         if self.latencies_small_s:
-            snap["requests_small"] = len(self.latencies_small_s)
+            snap["requests_small"] = self.latencies_small_s.count
             snap["latency_ms_small"] = {
                 k: round(v * 1e3, 4)
                 for k, v in percentiles(self.latencies_small_s).items()
@@ -218,6 +285,14 @@ class Collector:
             snap["factor_cache"] = dict(factor_cache)
         if self.replica_id is not None:
             snap["replica_id"] = str(self.replica_id)
+        # reservoir honesty marker: set the moment ANY raw population
+        # outgrew its cap.  merge_snapshots reads it to refuse pooling a
+        # subsample as if it were the full population (worst-tail max is
+        # the honest degraded answer); absent on uncapped runs so the
+        # schema stays what it always was.
+        if any(r.capped for r in (self.latencies_s, self.latencies_small_s,
+                                  self.queue_waits_s, self.devices_s)):
+            snap["samples_capped"] = True
         if samples:
             snap["samples"] = {
                 "latency_s": list(self.latencies_s),
@@ -259,17 +334,21 @@ _SAMPLE_KEYS = {
 
 def _merge_pcts(snaps: list[dict], name: str) -> dict | None:
     """One merged percentile block across `snaps`.  Pools the raw sample
-    populations when EVERY contributing snapshot carries them (exact
-    percentiles of the union); otherwise the elementwise max — the honest
-    degraded answer, because a worst-tail bound is the only percentile
-    that survives aggregation without the populations."""
+    populations when EVERY contributing snapshot carries them IN FULL
+    (exact percentiles of the union); otherwise the elementwise max — the
+    honest degraded answer, because a worst-tail bound is the only
+    percentile that survives aggregation without the populations.  A
+    reservoir-capped contributor (`samples_capped`) degrades the merge the
+    same way: its samples are a uniform subsample, and pooling a subsample
+    as if it were the population would silently bias the union's tail."""
     present = [s for s in snaps if name in s]
     if name == "latency_ms":
         present = snaps  # total block: every snapshot has it
     if not present:
         return None
     skey = _SAMPLE_KEYS[name]
-    if all("samples" in s for s in present):
+    if all("samples" in s and not s.get("samples_capped")
+           for s in present):
         pool = [v for s in present for v in s["samples"].get(skey, ())]
         if not pool:
             return {"p50": 0.0, "p95": 0.0, "p99": 0.0}

@@ -6,9 +6,9 @@
 PY ?= python
 
 .PHONY: all test benchmarking bench-explicit bench-small bench-blocktri \
-	bench-blocktri-par bench-arrowhead bench-update bench-refine tune \
-	audit lint robust serve-smoke serve-bench serve-replicas serve-trace \
-	native clean
+	bench-blocktri-par bench-arrowhead bench-update bench-refine \
+	bench-session tune audit lint robust serve-smoke serve-bench \
+	serve-replicas serve-trace native clean
 
 all: test
 
@@ -148,6 +148,28 @@ bench-refine:
 	$(PY) -m capital_tpu.obs serve-report bench_refine.jsonl \
 		--max-refine-iters 6 --min-converged-frac 0.99
 
+# streaming-session gate (docs/SERVING.md "Streaming sessions", round 19):
+# the sliding-window steady-state cycle — extend(slide) onto the resident
+# chain factor + contract(slide), a pure slice — vs refactoring the whole
+# nblocks window, the only move a cache-less server has.  Gated >= 5x at
+# the flagship geometry (structural ~nblocks/slide = 8x; measured ~9x on
+# this rig), with always-on f64-NumPy residual gates on the MARGINALIZED
+# slid window (head D <- L_k L_k^T — a wrong marginalization blows the
+# gate) and the bitwise replay pin (extend-replay of the truncated chain
+# == the contracted factor, max |delta| exactly 0).  The 50-request mixed
+# session workload (bursty arrivals, long-tail lifetimes, all three
+# accuracy tiers) then gates session hit-rate >= 0.85 post-warmup and
+# zero steady-state recompiles; obs serve-report re-gates the ledger's
+# serve:session_stats record — fails loudly if no record carries it.
+bench-session:
+	rm -f bench_session.jsonl
+	$(PY) -m capital_tpu.bench session --platform cpu --dtype float32 \
+		--nblocks 64 --block 128 --slide 8 --batch 1 --nrhs 2 \
+		--iters 5 --min-speedup 5 --min-hit-rate 0.85 \
+		--ledger bench_session.jsonl
+	$(PY) -m capital_tpu.obs serve-report bench_session.jsonl \
+		--min-session-hit-rate 0.85 --max-reseeds 0
+
 # model-vs-compiled drift gate on the flagship configs (docs/OBSERVABILITY.md);
 # compile-only — runs in CI without a TPU (exit non-zero on drift).  The
 # bench.trace step is the phase-attribution gate: it decomposes a real
@@ -158,7 +180,8 @@ bench-refine:
 # The generous 0.995 bound absorbs CPU-interpret emulation; what it pins
 # is that attribution works end to end.
 audit: serve-smoke serve-bench serve-replicas serve-trace bench-blocktri \
-	bench-blocktri-par bench-arrowhead bench-update bench-refine lint
+	bench-blocktri-par bench-arrowhead bench-update bench-refine \
+	bench-session lint
 	$(PY) -m capital_tpu.obs audit cholinv --n 4096 --platform cpu
 	$(PY) -m capital_tpu.obs audit cacqr --m 16384 --n 512 --platform cpu
 	$(PY) -m capital_tpu.obs robust-gate --platform cpu
@@ -282,5 +305,6 @@ clean:
 		lint_report.jsonl bench_small.jsonl serve_bench.jsonl serve_cache \
 		bench_trace.jsonl serve_replicas.jsonl serve_replicas_cache \
 		bench_blocktri.jsonl bench_update.jsonl bench_refine.jsonl \
-		bench_arrowhead.jsonl serve_trace.jsonl serve_trace_chrome.json
+		bench_arrowhead.jsonl serve_trace.jsonl serve_trace_chrome.json \
+		bench_session.jsonl
 	find . -name __pycache__ -type d -exec rm -rf {} +

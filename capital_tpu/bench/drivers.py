@@ -1633,6 +1633,308 @@ def _update_serve_smoke(n: int, k: int, dtype, ledger=None) -> dict:
     }
 
 
+def session(args) -> dict:
+    """Bench streaming state-space sessions (serve/sessions.py +
+    models/blocktri.extend/contract): the steady-state sliding-window
+    cycle — append --slide new blocks onto the resident chain factor,
+    contract the --slide oldest away — measured incrementally against
+    REFACTOR-FROM-SCRATCH of the slid window, the only alternative a
+    cache-less server has (docs/SERVING.md 'Streaming sessions': the
+    wire carries only the new blocks, so serving without a resident
+    factor means re-factoring all nblocks).  contract() is a pure slice
+    (zero flops), so the incremental cycle costs one extend(slide) and
+    the structural win is ~nblocks/slide — the round-19 flagship gate:
+    >= 5x at nblocks=64, block=128, slide=8.
+
+    The f64-NumPy residual gates are always-on (the bench-arrowhead
+    discipline): the slid-window factor — resident chain extended then
+    contracted, exactly the serve composition — must solve the
+    MARGINALIZED window matrix (head D ← L_k·L_kᵀ, head coupling zero;
+    models/blocktri.contract docstring) to working-precision tolerance,
+    both solve and factor-reconstruction residuals.  The replay pin
+    holds the docstring's bitwise claim: re-extending the truncated
+    chain from the retained carry reproduces the contracted factor's
+    trailing blocks exactly (max |Δ| == 0).
+
+    --min-hit-rate additionally runs the 50-request mixed session serve
+    workload (bursty arrivals, long-tail lifetimes, sliding append/
+    contract/solve cycles over all three accuracy tiers) through a real
+    SolveEngine + SessionManager, gating post-warmup session hit-rate
+    >= the floor AND zero steady-state executable recompiles (session
+    residency is host-side state keyed by session id — session churn
+    must never trigger a compile), and emitting the serve:session_stats
+    ledger record ``obs serve-report --min-session-hit-rate /
+    --max-reseeds`` re-gates."""
+    from capital_tpu.models import blocktri as bt_mod
+
+    dtype = jnp.dtype(args.dtype)
+    grid = Grid.square(c=1, devices=jax.devices()[:1])
+    prec = _precision(args, dtype)
+    nblocks, b, batch, nrhs = args.nblocks, args.block, args.batch, args.nrhs
+    slide = args.slide
+    if not 0 < slide < nblocks:
+        sys.exit(f"session: --slide {slide} must be in (0, --nblocks "
+                 f"{nblocks})")
+    impl = args.impl
+    if impl == "auto" and jax.default_backend() != "tpu":
+        # the bench-blocktri honest-wall pin: off-TPU 'auto' is the xla
+        # scan, never the pallas interpreter
+        impl = "xla"
+
+    import numpy as np
+
+    # nblocks + slide chain blocks: the first nblocks seed the resident
+    # window, the last slide are the streamed-in extension (its leading
+    # coupling C[:, nblocks] is LIVE — it ties the new blocks to the old
+    # window tail, the session_append contract)
+    (Dj, Cj, _), (Dn, Cn, _) = _blocktri_batch(nblocks + slide, b, batch,
+                                               nrhs, dtype, seed=7)
+    ext_fn = jax.jit(lambda d, c, carry: bt_mod.extend(
+        d, c, carry, precision=prec, impl=impl))
+    fac_fn = jax.jit(lambda d, c: bt_mod.factor(
+        d, c, precision=prec, impl=impl))
+
+    L0, Wt0, info0 = jax.block_until_ready(
+        fac_fn(Dj[:, :nblocks], Cj[:, :nblocks]))
+    if int(jnp.sum(info0 != 0)):
+        sys.exit("session: seed window factorization reports info != 0")
+    carry = L0[:, -1]
+    Dext = jax.block_until_ready(Dj[:, nblocks:])
+    Cext = jax.block_until_ready(Cj[:, nblocks:])
+
+    calls = max(args.iters, 3)
+    # incremental side: ONE extend(slide) per cycle — contract is a pure
+    # slice with no device work, so it contributes nothing to time
+    samples = harness.latency_samples(
+        lambda: ext_fn(Dext, Cext, carry), calls=calls, warmup=3)
+    # baseline: refactor the slid nblocks-window from scratch (factor()
+    # zeroes the head coupling itself, so the operand slice is exact)
+    bsamples = harness.latency_samples(
+        lambda: fac_fn(Dj[:, slide:], Cj[:, slide:]), calls=calls,
+        warmup=1)
+    # min-of-samples both sides: algorithms, not scheduler noise
+    # (the bench-update estimator rationale)
+    t = min(samples)
+    t_base = min(bsamples)
+    speedup = t_base / t
+    print(f"# speedup {speedup:.1f}x vs refactor-from-scratch at "
+          f"nblocks={nblocks} b={b} slide={slide} "
+          f"(refactor {t_base / batch * 1e3:.2f} ms/problem, "
+          f"append {t / batch * 1e3:.3f} ms/problem)")
+
+    # ---- always-on correctness gates (f64 NumPy side) ----------------------
+    Lx, Wtx, infox = jax.block_until_ready(ext_fn(Dext, Cext, carry))
+    if int(jnp.sum(infox != 0)):
+        sys.exit("session: extend of the streamed blocks reports info != 0")
+    Lfull = jnp.concatenate([L0, Lx], axis=1)
+    Wtfull = jnp.concatenate([Wt0, Wtx], axis=1)
+    Lc, Wtc = bt_mod.contract(Lfull, Wtfull, slide)
+    # replay pin (the contract docstring's bitwise claim): re-extending
+    # the truncated chain — head coupling LIVE, carried from the retained
+    # L_{slide-1} — reproduces every factor block the contract kept, bit
+    # for bit
+    Lr, Wtr, infor = jax.block_until_ready(
+        ext_fn(Dj[:, slide:], Cj[:, slide:], Lfull[:, slide - 1]))
+    if int(jnp.sum(infor != 0)):
+        sys.exit("session: replay refactor reports info != 0")
+    replay_delta = max(
+        float(jnp.max(jnp.abs(Lr - Lc))),
+        float(jnp.max(jnp.abs(Wtr - Wtc))),
+    )
+    print(f"# contract replay pin: max |Δ| = {replay_delta:g} "
+          f"(extend-replay of the truncated chain vs contracted factor)")
+    if replay_delta != 0.0:
+        sys.exit(
+            f"contract replay pin failed: trailing factor blocks differ "
+            f"from the truncated-chain refactor by {replay_delta:g} "
+            "(contract must be a pure slice)"
+        )
+    # the MARGINALIZED window matrix the contracted factor answers for
+    # (f64 masters; head diagonal from the f64 cast of the factor block)
+    Lcn = np.asarray(Lc, np.float64)
+    Wcn = np.asarray(Wtc, np.float64).transpose(0, 1, 3, 2)  # W_i
+    Dw = Dn[:, slide:].copy()
+    Dw[:, 0] = Lcn[:, 0] @ Lcn[:, 0].transpose(0, 2, 1)
+    Cw = Cn[:, slide:].copy()
+    Cw[:, 0] = 0.0
+    Ad = _blocktri_dense(Dw, Cw)
+    rng = np.random.default_rng(19)
+    Bn = rng.standard_normal((batch, nblocks, b, nrhs))
+    Bj = jax.block_until_ready(jnp.asarray(Bn, dtype))
+    X = jax.block_until_ready(jax.jit(
+        lambda l, w, rhs: bt_mod.solve(l, w, rhs, precision=prec,
+                                       impl=impl))(Lc, Wtc, Bj))
+    n = nblocks * b
+    Xn = np.asarray(X, np.float64).reshape(batch, n, nrhs)
+    Bd = Bn.reshape(batch, n, nrhs)
+    tol = _tolerance(dtype)
+    worst = max(
+        float(np.linalg.norm(Ad[i] @ Xn[i] - Bd[i])
+              / np.linalg.norm(Bd[i]))
+        for i in range(batch)
+    )
+    _gate("session_solve_residual", worst, tol)
+    # factor reconstruction residual of the contracted chain vs the
+    # marginalized window (blockwise, the bench-blocktri reconstruction)
+    R = np.zeros_like(Ad)
+    for i in range(nblocks):
+        sl = slice(i * b, (i + 1) * b)
+        R[:, sl, sl] = Lcn[:, i] @ Lcn[:, i].transpose(0, 2, 1)
+        if i:
+            up = slice((i - 1) * b, i * b)
+            R[:, sl, sl] += Wcn[:, i] @ Wcn[:, i].transpose(0, 2, 1)
+            blk = Wcn[:, i] @ Lcn[:, i - 1].transpose(0, 2, 1)
+            R[:, sl, up] = blk
+            R[:, up, sl] = blk.transpose(0, 2, 1)
+    _gate(
+        "session_factor_residual",
+        float(np.linalg.norm(R - Ad) / np.linalg.norm(Ad)),
+        tol,
+    )
+
+    smoke = None
+    if args.min_hit_rate:
+        smoke = _session_serve_workload(min(b, 16), dtype,
+                                        ledger=args.ledger)
+        print(f"# serve workload: {smoke['requests']} requests over "
+              f"{smoke['sessions']} sessions, session hit_rate "
+              f"{smoke['hit_rate']:.3f}, {smoke['reseeds']} reseeds, "
+              f"{smoke['recompiles']} steady-state recompiles")
+
+    # useful flops of the incremental side: extend(slide) chain work
+    flops = batch * slide * (b**3 / 3.0 + 3.0 * b**3)
+    rec = harness.report(
+        "session_speedup", t, flops, dtype, nblocks=nblocks, block=b,
+        slide=slide, batch=batch, nrhs=nrhs, impl=impl, grid=repr(grid),
+        speedup=round(speedup, 2),
+        refactor_ms=round(t_base / batch * 1e3, 3),
+        append_ms=round(t / batch * 1e3, 4),
+        wall_ms={k: round(v * 1e3, 4)
+                 for k, v in harness.percentiles(samples).items()},
+        **({"serve_workload": smoke} if smoke else {}),
+    )
+    cfg = {"op": "session_append", "impl": impl, "nblocks": nblocks,
+           "block": b, "slide": slide}
+    gates = []
+    if args.min_speedup and speedup < args.min_speedup:
+        gates.append(
+            f"speedup gate failed: {speedup:.1f}x < {args.min_speedup}x "
+            f"vs refactor-from-scratch at nblocks={nblocks} b={b} "
+            f"slide={slide}"
+        )
+    if smoke and smoke["hit_rate"] < args.min_hit_rate:
+        gates.append(
+            f"session residency gate failed: hit_rate "
+            f"{smoke['hit_rate']:.3f} < {args.min_hit_rate}"
+        )
+    if smoke and smoke["recompiles"]:
+        gates.append(
+            f"zero-recompile gate failed: {smoke['recompiles']} executable "
+            "compiles during steady-state session traffic"
+        )
+    _ledger_append(args, rec, name="session", grid=grid, dtype=dtype,
+                   cfg=cfg)
+    if gates:
+        sys.exit("; ".join(gates))
+    return rec
+
+
+def _session_serve_workload(b: int, dtype, ledger=None) -> dict:
+    """The 50-request mixed session workload (bench-session gate): bursty
+    session arrivals (seeded RNG, 1-3 sessions per burst), long-tail
+    lifetimes (geometric cycle counts — most sessions die young, a few
+    live many sliding-window cycles), each cycle one append(slide) +
+    contract(slide) + solve at a mixed accuracy tier.  Returns the delta
+    counters the caller gates on — session hit-rate over THIS traffic and
+    executable compiles after the one-time per-bucket warmup — and, when
+    `ledger` is given, appends the manager's serve:session_stats record
+    plus the engine's serve:request_stats record so ``obs serve-report
+    --min-session-hit-rate / --max-reseeds`` has records to gate."""
+    import numpy as np
+
+    from capital_tpu.serve import sessions as sessions_mod
+    from capital_tpu.serve.engine import ServeConfig, SolveEngine
+
+    rng = np.random.default_rng(17)
+    nb_w, nb_s, nrhs = 8, 4, 2  # window blocks, slide blocks, RHS cols
+    cfg = ServeConfig(nblocks_buckets=(nb_s, nb_w), block_buckets=(b,),
+                      nrhs_buckets=(nrhs,), max_batch=2, max_delay_s=0.0,
+                      oversize="reject")
+    eng = SolveEngine(cfg=cfg)
+    mgr = sessions_mod.SessionManager(eng)
+
+    def chain(k):
+        G = rng.standard_normal((k, b, b))
+        D = (G @ G.transpose(0, 2, 1) / b + 3.0 * np.eye(b)).astype(dtype)
+        C = (0.3 / np.sqrt(b)
+             * rng.standard_normal((k, b, b))).astype(dtype)
+        return D, C
+
+    def rhs():
+        return rng.standard_normal((nb_w, b, nrhs)).astype(dtype)
+
+    # warm every program the mix touches (open@nb_w, append@nb_s, solve
+    # at all three tiers); everything after this must hit the executable
+    # cache — session residency is host-side state, so session churn must
+    # never compile
+    D, C = chain(nb_w)
+    assert mgr.open("warm", D, C).ok
+    Da, Ca = chain(nb_s)
+    assert mgr.append("warm", Da, Ca).ok
+    assert mgr.contract("warm", nb_s).ok
+    for tier in ("balanced", "fast", "guaranteed"):
+        r = mgr.solve("warm", rhs(), accuracy_tier=tier)
+        assert r.ok, r.error
+    assert mgr.close("warm").ok
+    c0 = eng.cache_stats()["compiles"]
+    h0, m0 = mgr.hits, mgr.misses
+
+    tiers = ("balanced", "balanced", "balanced", "fast", "guaranteed")
+    active: list[list] = []
+    sid_n = 0
+    requests = 0
+    while requests < 50:
+        if not active or (len(active) < 6 and rng.random() < 0.3):
+            # burst arrival: 1-3 sessions open back to back
+            for _ in range(int(rng.integers(1, 4))):
+                sid = f"s{sid_n}"
+                sid_n += 1
+                D, C = chain(nb_w)
+                assert mgr.open(sid, D, C).ok
+                requests += 1
+                # long-tail lifetime in sliding-window cycles
+                active.append([sid, 1 + int(rng.geometric(0.35))])
+        i = int(rng.integers(len(active)))
+        sid = active[i][0]
+        Da, Ca = chain(nb_s)
+        assert mgr.append(sid, Da, Ca).ok
+        assert mgr.contract(sid, nb_s).ok
+        r = mgr.solve(sid, rhs(),
+                      accuracy_tier=tiers[int(rng.integers(len(tiers)))])
+        assert r.ok, r.error
+        requests += 3
+        active[i][1] -= 1
+        if active[i][1] <= 0:
+            assert mgr.close(active.pop(i)[0]).ok
+            requests += 1
+    for sid, _ in active:
+        assert mgr.close(sid).ok
+    recompiles = eng.cache_stats()["compiles"] - c0
+    if ledger:
+        mgr.emit_session_stats(ledger)
+        eng.emit_stats(ledger)
+    hits = mgr.hits - h0
+    lookups = hits + mgr.misses - m0
+    st = mgr.stats()
+    return {
+        "requests": requests,
+        "sessions": sid_n,
+        "hit_rate": round(hits / lookups, 4) if lookups else 1.0,
+        "reseeds": st["reseeds"],
+        "recompiles": recompiles,
+    }
+
+
 def refine(args) -> dict:
     """Bench mixed-precision iterative refinement (robust/refine + the
     serve accuracy tiers): the guaranteed-tier posv program — factor one
@@ -1885,6 +2187,7 @@ DRIVERS = {
     "arrowhead": arrowhead,
     "update": update,
     "refine": refine,
+    "session": session,
 }
 
 
@@ -1999,6 +2302,12 @@ def build_parser() -> argparse.ArgumentParser:
         "and the dense corner; n = nblocks * block + border)",
     )
     p.add_argument(
+        "--slide", type=int, default=8,
+        help="session: sliding-window stride in blocks — each steady-state "
+        "cycle appends this many new blocks and contracts this many old "
+        "ones away (must be in (0, --nblocks))",
+    )
+    p.add_argument(
         "--impl", default="auto",
         choices=["auto", "pallas", "xla", "partitioned"],
         help="blocktri: chain implementation; auto = pallas scan on TPU, "
@@ -2030,7 +2339,10 @@ def build_parser() -> argparse.ArgumentParser:
         "refine: the same flag gates the FACTOR-PHASE narrow-vs-wide "
         "potrf speedup (the round-14 gate: 1.5 at n=1024 f64 on the CPU "
         "rig — end-to-end latency is reported ungated, see the driver "
-        "docstring)",
+        "docstring); "
+        "session: gates the incremental append(slide) vs "
+        "refactor-from-scratch speedup (the round-19 gate: 5 at "
+        "nblocks=64, block=128, slide=8)",
     )
     p.add_argument(
         "--max-resid-ratio", type=float, default=0.0,
@@ -2043,7 +2355,9 @@ def build_parser() -> argparse.ArgumentParser:
         "--min-hit-rate", type=float, default=0.0,
         help="update: run the 50-request mixed chol_update/posv_cached "
         "serve smoke and fail below this residency hit-rate (the round-12 "
-        "gate: 0.9) or on any steady-state executable recompile",
+        "gate: 0.9) or on any steady-state executable recompile; "
+        "session: the same flag gates the 50-request mixed session "
+        "workload (the round-19 gate: 0.85, zero recompiles)",
     )
     p.add_argument(
         "--phase-attr", action="store_true",

@@ -23,7 +23,7 @@ from capital_tpu.lint.program import ProgramTarget
 TARGET_NAMES = ("cholinv", "cacqr", "serve", "batched_small", "serve_sched",
                 "serve_traced", "cholinv_fused", "blocktri",
                 "blocktri_partitioned", "arrowhead", "update_small",
-                "refine")
+                "refine", "session")
 
 
 def _grid():
@@ -308,6 +308,48 @@ def refine_target(
     )
 
 
+def session_targets(
+    nblocks: int = 4, b: int = 16, nrhs: int = 2, capacity: int = 4,
+    dtype=jnp.float32,
+) -> list[ProgramTarget]:
+    """The streaming-session bucket programs (serve/sessions protocol
+    through api.batched — the executables engine._submit_session routes
+    to; docs/SERVING.md 'Streaming sessions'): the shared open/append
+    chain-extension program under ``SS::extend`` and the resident-factor
+    sweep program under ``SS::solve`` — both phase tags under the
+    phase-coverage rule.  Cache-key hygiene is the protocol's load-
+    bearing claim: session ids resolve to resident factors HOST-side, so
+    the programs see only bucket-shaped arrays — the 4-stack
+    (capacity, 4, nblocks, b, b) = [D; C; L; Wt] solve packing and the
+    (capacity, 2, nblocks, b, b) extend packing — and session churn can
+    never recompile anything.  Forced impl='pallas' so the interior
+    chain scans ride the kernel route serve routes on TPU;
+    ``flops_audited=False`` for the same interpret-rig reason as
+    blocktri_target.  No donation — the engine's no-donate rule for
+    session ops: the landed (L, Wt) stack is concatenated onto the
+    RESIDENT chain at the sink, so the operand must survive dispatch."""
+    from capital_tpu.serve import api
+
+    dt = jnp.dtype(dtype)
+    a2_sds = jax.ShapeDtypeStruct((capacity, 2, nblocks, b, b), dt)
+    carry_sds = jax.ShapeDtypeStruct((capacity, b, b), dt)
+    a4_sds = jax.ShapeDtypeStruct((capacity, 4, nblocks, b, b), dt)
+    b_sds = jax.ShapeDtypeStruct((capacity, nblocks, b, nrhs), dt)
+    mk = f"b{capacity}-nb{nblocks}-bs{b}"
+    return [
+        ProgramTarget(
+            name=f"serve-session-extend-{mk}",
+            fn=api.batched("session_extend", impl="pallas"),
+            args=(a2_sds, carry_sds), flops_audited=False,
+        ),
+        ProgramTarget(
+            name=f"serve-session-solve-{mk}",
+            fn=api.batched("session_solve", impl="pallas"),
+            args=(a4_sds, b_sds), flops_audited=False,
+        ),
+    ]
+
+
 def cholinv_fused_target(n: int = 512, dtype=jnp.float32) -> ProgramTarget:
     """The fused-recursion-tail cholinv program (CholinvConfig.
     tail_fuse_depth > 0): n=512 with bc=128 and depth 2 fuses the whole
@@ -455,6 +497,8 @@ def flagship_targets(names=None) -> list[ProgramTarget]:
             out.append(update_small_target())
         elif name == "refine":
             out.append(refine_target())
+        elif name == "session":
+            out.extend(session_targets())
         else:
             raise ValueError(
                 f"unknown lint target {name!r}; expected one of {TARGET_NAMES}"

@@ -613,6 +613,40 @@ def extend(D, C, L_last, *, block: int = 0, seg: int = 0,
     return L, Wt, _combine(infos, nblocks, b, offset)
 
 
+def contract(L, Wt, k: int):
+    """Drop the `k` OLDEST blocks from an already-factored chain — the
+    sliding-window dual of `extend` (ROADMAP item 5's streaming
+    state-space sessions; the serve `session_contract` op).
+
+    Elimination runs head→tail, so block i's factors depend only on
+    blocks ≤ i: truncating the head leaves every retained factor block
+    UNCHANGED, and contract is a pure slice — no kernel, no compile, no
+    flops.  The retained representation `(L[:, k:], Wt[:, k:])` is
+    bitwise what `extend(D[:, k:], C[:, k:], L[:, k - 1])` would replay
+    (tests/test_sessions.py pins it), and `Wt[:, k]` — the coupling into
+    the dropped prefix — stays in place untouched: both solve sweeps are
+    structurally blind to it (the forward scan starts from a ZERO carry
+    and the backward sweep consumes the one-shifted Wt), so `solve` on
+    the contracted factor needs no zeroing.
+
+    The matrix the contracted factor represents is the MARGINAL
+    (Schur-complemented) precision of the retained window, not the raw
+    truncated chain: its head diagonal is D_k − W_k·W_kᵀ = L_k·L_kᵀ,
+    computable from the factor alone, with the head coupling gone.  A
+    caller maintaining an explicit (D, C) window (the SessionManager's
+    residual seam) must set D[:, k] ← L[:, k]·L[:, k]ᵀ and C[:, k] ← 0
+    when it slides.
+
+    Returns (L[:, k:], Wt[:, k:]) — views, no copy."""
+    _check_chain(L, Wt, op="blocktri contract")
+    nblocks = L.shape[1]
+    if not 0 <= k < nblocks:
+        raise ValueError(
+            f"blocktri contract: k must be in [0, nblocks={nblocks}), "
+            f"got {k}")
+    return L[:, k:], Wt[:, k:]
+
+
 def solve(L, Wt, B, *, block: int = 0, seg: int = 0,
           precision: str | None = "highest", impl: str = "auto",
           interpret: bool | None = None):

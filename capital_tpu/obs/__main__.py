@@ -214,6 +214,7 @@ def _serve_report(args) -> int:
     rows = [r for r in recs if r.get("request_stats") is not None]
     trows = [r for r in recs if r.get("serve_trace") is not None]
     wrows = [r for r in recs if r.get("serve_window") is not None]
+    srows = [r for r in recs if r.get("session_stats") is not None]
     bad = 0
     for i, r in enumerate(rows):
         for p in ledger.validate_request_stats(r["request_stats"]):
@@ -230,6 +231,11 @@ def _serve_report(args) -> int:
             print(f"malformed serve_window record #{i}: {p}",
                   file=sys.stderr)
             bad += 1
+    for i, r in enumerate(srows):
+        for p in ledger.validate_session_stats(r["session_stats"]):
+            print(f"malformed session_stats record #{i}: {p}",
+                  file=sys.stderr)
+            bad += 1
     if bad:
         return 2
     gates_on = (args.min_hit_rate is not None
@@ -243,8 +249,10 @@ def _serve_report(args) -> int:
                 or args.min_replicas is not None
                 or args.min_trace_complete is not None
                 or args.min_windows is not None
+                or args.min_session_hit_rate is not None
+                or args.max_reseeds is not None
                 or args.aggregate)
-    if not rows and not trows and not wrows:
+    if not rows and not trows and not wrows and not srows:
         print(f"# no serve records in {args.ledger} "
               f"({len(recs)} records total)")
         return 1 if gates_on else 0
@@ -441,6 +449,47 @@ def _serve_report(args) -> int:
             f"{args.min_windows} (telemetry not enabled via --window-s, "
             "or the run closed too few non-empty windows)"
         )
+    # streaming-session protocol counters (serve:session_stats records —
+    # serve/sessions.py SessionManager.emit_session_stats): hit_rate is
+    # the fraction of resident requests that found their chain still in
+    # the FactorCache, reseeds counts re-opens of evicted sessions.  Both
+    # gates fail loudly when requested with no session_stats record in
+    # the ledger — a gate nothing exercised is a silently-dead gate
+    # (docs/SERVING.md 'Streaming sessions').
+    for i, r in enumerate(srows):
+        ss = r["session_stats"]
+        print(
+            f"# session[{i}] opens={ss['opens']} reseeds={ss['reseeds']} "
+            f"appends={ss['appends']} solves={ss['solves']} "
+            f"contracts={ss['contracts']} closes={ss['closes']} "
+            f"failures={ss['failures']} evicted={ss['evicted_failures']} "
+            f"hit_rate={ss['hit_rate']:.3f} "
+            f"blocks +{ss['blocks_appended']}/-{ss['blocks_dropped']}"
+        )
+        if (args.min_session_hit_rate is not None
+                and ss["hit_rate"] < args.min_session_hit_rate):
+            failures.append(
+                f"session record #{i}: session hit_rate "
+                f"{ss['hit_rate']:.3f} < {args.min_session_hit_rate} "
+                "(resident chains evicted under cache pressure mid-"
+                "session — raise factor_cache_bytes or contract sooner; "
+                "docs/SERVING.md 'Streaming sessions')"
+            )
+        if (args.max_reseeds is not None
+                and ss["reseeds"] > args.max_reseeds):
+            failures.append(
+                f"session record #{i}: {ss['reseeds']} reseed(s) > "
+                f"--max-reseeds {args.max_reseeds} (clients re-opening "
+                "evicted sessions — each reseed re-ships and re-factors "
+                "the whole window the protocol exists to avoid)"
+            )
+    if (args.min_session_hit_rate is not None
+            or args.max_reseeds is not None) and not srows:
+        failures.append(
+            "--min-session-hit-rate/--max-reseeds requested but no record "
+            "carries a session_stats block (no session traffic served, or "
+            "the producer never called emit_session_stats?)"
+        )
     # cross-replica aggregation (docs/SERVING.md "Multi-replica serving"):
     # fold every replica-TAGGED record through stats.merge_snapshots and
     # report the fleet view — summed counts, worst tail, summed router-block
@@ -539,7 +588,8 @@ def _serve_report(args) -> int:
     if failures:
         return 1
     print(f"# serve-report OK ({len(rows)} request_stats, "
-          f"{len(trows)} serve_trace, {len(wrows)} serve_window record(s))")
+          f"{len(trows)} serve_trace, {len(wrows)} serve_window, "
+          f"{len(srows)} session_stats record(s))")
     return 0
 
 
@@ -858,6 +908,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "serve_window records (one per closed non-empty "
                         "telemetry window); fails loudly when telemetry "
                         "was never enabled")
+    s.add_argument("--min-session-hit-rate", type=float, default=None,
+                   help="fail when any session_stats record's hit_rate "
+                        "(serve/sessions.py resident-chain residency) is "
+                        "below this; fails loudly when NO record carries "
+                        "a session_stats block")
+    s.add_argument("--max-reseeds", type=int, default=None,
+                   help="fail when any session_stats record counts more "
+                        "than this many reseeds (re-opens of evicted "
+                        "sessions); fails loudly when NO record carries "
+                        "a session_stats block")
     s.set_defaults(fn=_serve_report)
 
     lr = sub.add_parser(

@@ -238,7 +238,9 @@ _REQ_STATS_CACHE = ("hits", "misses", "warmup_compiles", "hit_rate")
 #: serve) — an unknown key means the producer and the tooling drifted apart.
 _REQ_STATS_OPS = ("posv", "lstsq", "inv", "posv_blocktri",
                   "chol_update", "chol_downdate", "posv_cached",
-                  "blocktri_extend", "posv_arrowhead")
+                  "blocktri_extend", "posv_arrowhead",
+                  "session_open", "session_append", "session_solve",
+                  "session_contract", "session_close")
 #: factor_cache counter block (serve/factorcache.FactorCache.stats):
 #: attached to request_stats only by engines that served factor-token
 #: traffic — records without it stay valid unchanged.
@@ -376,6 +378,58 @@ def validate_request_stats(block) -> list[str]:
                     f"factor_cache.hit_rate {hr!r} inconsistent with "
                     f"hits={h} misses={m} (expected {h / (h + m):.6f})"
                 )
+            # optional per-entry byte map + eviction-age histogram
+            # (PR 19 session eviction-pressure view): additive keys —
+            # pre-PR-19 records (and merged snapshots, which fold only
+            # the scalar counters) stay valid without them.
+            if "entry_bytes" in fc:
+                eb = fc["entry_bytes"]
+                if not isinstance(eb, dict):
+                    probs.append(
+                        f"factor_cache.entry_bytes must be an object, "
+                        f"got {eb!r}")
+                else:
+                    for t, v in eb.items():
+                        if (not isinstance(v, int) or isinstance(v, bool)
+                                or v < 0):
+                            probs.append(
+                                f"factor_cache.entry_bytes[{t!r}] must be "
+                                f"a non-negative int, got {v!r}")
+                    ent, by = fc.get("entries"), fc.get("bytes")
+                    if isinstance(ent, int) and len(eb) != ent:
+                        probs.append(
+                            f"factor_cache.entry_bytes has {len(eb)} "
+                            f"entries but entries={ent}")
+                    if (isinstance(by, int) and eb
+                            and all(isinstance(v, int) for v in eb.values())
+                            and sum(eb.values()) != by):
+                        probs.append(
+                            f"factor_cache.entry_bytes sums to "
+                            f"{sum(eb.values())} but bytes={by}")
+            if "eviction_age_hist" in fc:
+                eh = fc["eviction_age_hist"]
+                if not isinstance(eh, dict):
+                    probs.append(
+                        f"factor_cache.eviction_age_hist must be an "
+                        f"object, got {eh!r}")
+                else:
+                    for bkt, v in eh.items():
+                        if not (isinstance(bkt, str) and bkt.isdigit()):
+                            probs.append(
+                                f"factor_cache.eviction_age_hist key "
+                                f"{bkt!r} is not a stringified age bucket")
+                        if (not isinstance(v, int) or isinstance(v, bool)
+                                or v < 0):
+                            probs.append(
+                                f"factor_cache.eviction_age_hist[{bkt!r}] "
+                                f"must be a non-negative int, got {v!r}")
+                    ev = fc.get("evictions")
+                    if (isinstance(ev, int) and eh
+                            and all(isinstance(v, int) for v in eh.values())
+                            and sum(eh.values()) != ev):
+                        probs.append(
+                            f"factor_cache.eviction_age_hist sums to "
+                            f"{sum(eh.values())} but evictions={ev}")
     # optional guaranteed-tier refinement telemetry (PR 14 —
     # Collector.note_refine): measured sweep counts and the worst landed
     # backward error.  Absent without accuracy_tier='guaranteed' traffic;
@@ -1000,6 +1054,71 @@ def validate_serve_window(block) -> list[str]:
     return probs
 
 
+#: session_stats schema (serve/sessions.SessionManager.stats): required
+#: counter keys of one serve:session_stats record.
+_SESSION_STATS_COUNTS = ("opens", "reseeds", "appends", "solves",
+                         "contracts", "closes", "failures",
+                         "evicted_failures", "hits", "misses",
+                         "sessions_open", "sessions_known",
+                         "blocks_appended", "blocks_dropped")
+
+
+def validate_session_stats(block) -> list[str]:
+    """Schema problems of one session_stats block ([] = valid) — a
+    `serve.sessions.SessionManager` counter snapshot (PR 19, docs/
+    SERVING.md 'Streaming sessions').  Same posture as request_stats:
+    structurally validated on every diff, never metric-compared — a
+    session workload's hit-rate is the workload's property; its gates are
+    ``obs serve-report --min-session-hit-rate / --max-reseeds``.
+    Coherence checks pin the manager's promises: hit_rate consistent
+    with hits/misses, misses == evicted_failures (the only miss is an
+    evicted factor), reseeds <= opens, sessions_open <= sessions_known,
+    blocks_dropped <= blocks_appended (a chain cannot contract blocks it
+    never streamed)."""
+    if not isinstance(block, dict):
+        return [f"session_stats is {type(block).__name__}, expected object"]
+    probs = []
+    if block.get("schema_version") != SCHEMA_VERSION:
+        probs.append(
+            f"schema_version {block.get('schema_version')!r} != "
+            f"{SCHEMA_VERSION}"
+        )
+    for key in _SESSION_STATS_COUNTS:
+        v = block.get(key)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            probs.append(f"{key} must be a non-negative int, got {v!r}")
+    hr = block.get("hit_rate")
+    if not isinstance(hr, (int, float)) or isinstance(hr, bool) \
+            or not 0.0 <= hr <= 1.0:
+        probs.append(f"hit_rate must be in [0, 1], got {hr!r}")
+    h, m = block.get("hits"), block.get("misses")
+    ints = all(isinstance(v, int) and not isinstance(v, bool)
+               for v in (h, m))
+    if (ints and isinstance(hr, (int, float)) and h + m > 0
+            and abs(hr - h / (h + m)) > 1e-6):
+        probs.append(
+            f"hit_rate {hr!r} inconsistent with hits={h} misses={m} "
+            f"(expected {h / (h + m):.6f})"
+        )
+    ev = block.get("evicted_failures")
+    if (isinstance(m, int) and isinstance(ev, int)
+            and not isinstance(m, bool) and m != ev):
+        probs.append(
+            f"misses {m} != evicted_failures {ev} (the only session "
+            "miss is an evicted resident factor)"
+        )
+    rs, op = block.get("reseeds"), block.get("opens")
+    if isinstance(rs, int) and isinstance(op, int) and rs > op:
+        probs.append(f"reseeds {rs} > opens {op}")
+    so, sk = block.get("sessions_open"), block.get("sessions_known")
+    if isinstance(so, int) and isinstance(sk, int) and so > sk:
+        probs.append(f"sessions_open {so} > sessions_known {sk}")
+    ba, bd = block.get("blocks_appended"), block.get("blocks_dropped")
+    if isinstance(ba, int) and isinstance(bd, int) and bd > ba:
+        probs.append(f"blocks_dropped {bd} > blocks_appended {ba}")
+    return probs
+
+
 def _event_status(rec: dict) -> Optional[str]:
     """The robustness status of a record, when it carries one.
 
@@ -1017,6 +1136,10 @@ def _event_status(rec: dict) -> Optional[str]:
     lint_report records (capital_tpu.lint CLI) for the same reason — their
     gate is ``obs lint-report``."""
     if rec.get("request_stats") is not None:
+        return "serve"
+    if rec.get("session_stats") is not None:
+        # streaming-session counter records (serve/sessions.py): gated
+        # by ``obs serve-report --min-session-hit-rate / --max-reseeds``
         return "serve"
     if rec.get("serve_trace") is not None \
             or rec.get("serve_window") is not None:
@@ -1082,6 +1205,13 @@ def diff(
             if probs:
                 raise LedgerIncompatible(
                     "malformed serve_window record: " + "; ".join(probs)
+                )
+        ss = r.get("session_stats")
+        if ss is not None:
+            probs = validate_session_stats(ss)
+            if probs:
+                raise LedgerIncompatible(
+                    "malformed session_stats record: " + "; ".join(probs)
                 )
         lr = r.get("lint_report")
         if lr is not None:

@@ -53,6 +53,10 @@ REQUIRED = {
                 "device", "respond"),
     "single": ("admit", "cache_lookup", "device", "respond"),
     "failed": ("admit", "respond"),
+    # host-side session administrative ops (session_contract/close):
+    # residency resolves on the host, nothing is dispatched — the chain
+    # collapses to the lookup (docs/SERVING.md 'Streaming sessions')
+    "session": ("admit", "cache_lookup", "respond"),
 }
 
 #: Pinned bubble tolerance: the largest host-side gap (seconds of

@@ -370,6 +370,53 @@ def _batched_extend(precision, impl: str):
     return f
 
 
+def _batched_session_extend(precision, impl: str):
+    """The session open/append bucket program (docs/SERVING.md "Streaming
+    sessions"): same operands and outputs as `_batched_extend` — ONE
+    compiled program serves both session_open (engine zeroes C[:, 0] and
+    seeds an identity carry host-side) and session_append (resident
+    carry, live coupling).  The interior extend traces muted() under the
+    SS::extend scope so the chain work is priced exactly once, under the
+    session tag the session stats attribute by."""
+    mapped = _TWO_IMPL_MAP[impl]
+
+    def f(a, carry):
+        nblocks, bs = a.shape[2], a.shape[3]
+        with tracing.scope("SS::extend"):
+            tracing.emit(flops=a.shape[0]
+                         * tracing.blocktri_chol_flops(nblocks, bs))
+            with tracing.muted():
+                L, Wt, info = blocktri.extend(a[:, 0], a[:, 1], carry,
+                                              precision=precision,
+                                              impl=mapped)
+        return jnp.stack([L, Wt], axis=1), info
+
+    return f
+
+
+def _batched_session_solve(precision, impl: str):
+    """The resident-factor session solve: the 4-stack operand packing
+    A = (batch, 4, nblocks, b, b) = [D; C; L; Wt] carries the session's
+    explicit window (for the guaranteed tier's residual operator) AND its
+    resident factor in one bucket-shaped array; the balanced program
+    reads only the factor half — two block-bidiagonal sweeps, no
+    factorization, info identically zero (residency installs only
+    healthy factors, the posv_cached contract)."""
+    mapped = _TWO_IMPL_MAP[impl]
+
+    def f(a, b):
+        nblocks, bs = a.shape[2], a.shape[3]
+        with tracing.scope("SS::solve"):
+            tracing.emit(flops=a.shape[0] * 2 * tracing.blocktri_solve_flops(
+                nblocks, bs, b.shape[-1]))
+            with tracing.muted():
+                X = blocktri.solve(a[:, 2], a[:, 3], b,
+                                   precision=precision, impl=mapped)
+        return X, jnp.zeros(a.shape[0], jnp.int32)
+
+    return f
+
+
 def _batched_refine(op: str, precision, impl: str, tier: str):
     """The guaranteed-tier bucket program: mixed-precision iterative
     refinement (robust/refine) over the flagship solve.  FIVE outputs —
@@ -389,6 +436,16 @@ def _batched_refine(op: str, precision, impl: str, tier: str):
             X, info, ri = refine.posv(a, b, **kw)
         elif op == "lstsq":
             X, info, ri = refine.lstsq(a, b, **kw)
+        elif op == "session_solve":
+            # resident-factor refinement (PR 14's factor= seam): the
+            # session's (L, Wt) ride the 4-stack packing (a[:, 2:4]) at
+            # the plan's factor dtype — correct() sweeps against them,
+            # the explicit (D, C) window half drives the high-precision
+            # residual operator, and no refactor happens at all
+            X, info, ri = refine.posv_blocktri(
+                a[:, 0], a[:, 1], b,
+                factor=(a[:, 2].astype(p.factor_dtype),
+                        a[:, 3].astype(p.factor_dtype)), **kw)
         else:  # posv_blocktri (bucket packing: a[:, 0]=D, a[:, 1]=C)
             X, info, ri = refine.posv_blocktri(a[:, 0], a[:, 1], b, **kw)
         return X, ri.iters, ri.converged, ri.resid, info
@@ -397,10 +454,11 @@ def _batched_refine(op: str, precision, impl: str, tier: str):
 
 
 #: the ops the accuracy-tier vocabulary applies to — the three flagship
-#: solves refine.py wraps.  Everything else (inv, the factor-residency
-#: ops) rejects a non-balanced tier loudly rather than silently serving
-#: the balanced program under a tier label.
-TIER_OPS = ("posv", "lstsq", "posv_blocktri")
+#: solves refine.py wraps, plus the session resident-factor solve (its
+#: guaranteed tier rides refine's factor= seam).  Everything else (inv,
+#: the factor-residency ops) rejects a non-balanced tier loudly rather
+#: than silently serving the balanced program under a tier label.
+TIER_OPS = ("posv", "lstsq", "posv_blocktri", "session_solve")
 
 
 def batched(op: str, precision: str | None = "highest",
@@ -470,6 +528,10 @@ def batched(op: str, precision: str | None = "highest",
         return _batched_posv_cached_miss(precision, impl)
     if op == "blocktri_extend":
         return _batched_extend(precision, impl)
+    if op == "session_extend":
+        return _batched_session_extend(precision, impl)
+    if op == "session_solve":
+        return _batched_session_solve(precision, impl)
     if impl == "vmap":
         return _batched_vmap(op, precision)
     if impl in ("pallas", "pallas_split"):

@@ -44,6 +44,22 @@ FACTOR_OPS = ("chol_update", "chol_downdate", "posv_cached",
 #: residency miss.  Never a client-visible submit op.
 MISS_OPS = ("posv_cached_miss",)
 
+#: the streaming-session protocol ops (serve/sessions.py, docs/SERVING.md
+#: "Streaming sessions") — all require factor_token = session id.
+#: session_open and session_append normalize to the ONE engine-internal
+#: `session_extend` bucket op (one compiled program serves both: the
+#: engine zeroes C[:, 0] and seeds an identity carry for opens);
+#: session_solve buckets under its own name with the 4-stack operand
+#: packing A = (4, nblocks, b, b) = [D; C; L; Wt].  session_contract and
+#: session_close are HOST-side administrative ops (a pure factor slice /
+#: a residency release) that never touch a compiled program — they
+#: bucket to None and land through the engine's host path.
+SESSION_OPS = ("session_open", "session_append", "session_solve",
+               "session_contract", "session_close")
+
+#: engine-internal session bucket ops (the compiled halves of SESSION_OPS).
+SESSION_BUCKET_OPS = ("session_extend", "session_solve")
+
 
 @dataclasses.dataclass(frozen=True)
 class Bucket:
@@ -128,7 +144,7 @@ def bucket_for(op: str, a_shape, b_shape, dtype: str, cfg,
     RHS) with posv's exact geometry (posv_cached_miss: (A, RHS), same
     shapes, different program); blocktri_extend as (appended chain
     (2, nblocks, b, b), resident carry (b, b))."""
-    if op not in OPS and op not in MISS_OPS:
+    if op not in OPS and op not in MISS_OPS and op not in SESSION_BUCKET_OPS:
         raise ValueError(f"unknown serve op {op!r}; expected one of {OPS}")
     if tier != "balanced":
         from capital_tpu.robust import refine
@@ -151,13 +167,25 @@ def bucket_for(op: str, a_shape, b_shape, dtype: str, cfg,
         if nb is None or kb is None:
             return None
         return Bucket(op, dtype, (nb, nb), (nb, kb), cfg.max_batch)
-    if op == "blocktri_extend":
+    if op in ("blocktri_extend", "session_extend"):
         _, nblocks, b, _ = a_shape
         nbb = _pick(cfg.nblocks_buckets, nblocks)
         bb = _pick(cfg.block_buckets, b)
         if nbb is None or bb is None:
             return None
         return Bucket(op, dtype, (2, nbb, bb, bb), (bb, bb),
+                      cfg.max_batch)
+    if op == "session_solve":
+        # 4-stack session pack: [D; C; L; Wt] — the explicit window AND
+        # the resident factor in one bucket-shaped operand (api.py
+        # `_batched_session_solve`); geometry buckets like posv_blocktri
+        _, nblocks, b, _ = a_shape
+        nbb = _pick(cfg.nblocks_buckets, nblocks)
+        bb = _pick(cfg.block_buckets, b)
+        kb = _pick(cfg.nrhs_buckets, b_shape[2])
+        if nbb is None or bb is None or kb is None:
+            return None
+        return Bucket(op, dtype, (4, nbb, bb, bb), (nbb, bb, kb),
                       cfg.max_batch)
     if op == "posv_blocktri":
         _, nblocks, b, _ = a_shape
@@ -212,8 +240,10 @@ def pad_operands(op: str, A, B, bucket: Bucket):
             return _pad_blocktri(A, B, bucket)
         if op == "posv_arrowhead":
             return _pad_arrowhead(A, B, bucket)
-        if op == "blocktri_extend":
+        if op in ("blocktri_extend", "session_extend"):
             return _pad_blocktri_extend(A, B, bucket)
+        if op == "session_solve":
+            return _pad_session_solve(A, B, bucket)
         if op in ("chol_update", "chol_downdate"):
             # diag(R, I) stays a valid upper factor (of diag(A, I)) and
             # the zero-filled V rows/columns make every padded rotation a
@@ -325,6 +355,32 @@ def _pad_blocktri_extend(A, carry, bucket: Bucket):
     return pa, pcarry
 
 
+def _pad_session_solve(A, B, bucket: Bucket):
+    """Structure-safe pad for the session 4-stack [D; C; L; Wt]: the
+    window half pads exactly like `_pad_blocktri` (diag(D_i, I) embeds,
+    zero couplings, appended identity blocks), and the factor half pads
+    CONSISTENTLY with it — diag(L_i, I) is the Cholesky factor of
+    diag(S_i, I) and the zero-padded Wt rows/columns keep both solve
+    sweeps' padded carries exact zeros, so the real blocks' solution is
+    BITWISE the unpadded one and the guaranteed tier's residual operator
+    sees residual ≡ 0 on every padded row (zero RHS against identity
+    diagonal blocks)."""
+    _, nblocks, b, _ = A.shape
+    nbb, bb = bucket.a_shape[1], bucket.a_shape[2]
+    kb = bucket.b_shape[2]
+    pa = jnp.pad(A, ((0, 0), (0, nbb - nblocks),
+                     (0, bb - b), (0, bb - b)))
+    eye = jnp.eye(bb, dtype=A.dtype)
+    tail = jnp.where(jnp.arange(bb) >= b, eye, jnp.zeros_like(eye))
+    blk = (jnp.arange(nbb) < nblocks)[:, None, None]
+    emb = jnp.where(blk, tail, eye)
+    pa = pa.at[0].add(emb)   # D -> diag(D_i, I), appended blocks I
+    pa = pa.at[2].add(emb)   # L -> diag(L_i, I), appended blocks I
+    pb = jnp.pad(B, ((0, nbb - nblocks), (0, bb - b),
+                     (0, kb - B.shape[2])))
+    return pa, pb
+
+
 def fill_problem(bucket: Bucket):
     """The benign problem that tops a short batch up to capacity: an
     identity operand (SPD for posv/inv, orthonormal columns for lstsq —
@@ -342,11 +398,19 @@ def fill_problem(bucket: Bucket):
         fb = jnp.zeros(bucket.b_shape, dt)
         fb = fb.at[nbb * bb:, :sb].set(jnp.eye(sb, dtype=dt))
         return fa, fb
-    if bucket.op in ("posv_blocktri", "blocktri_extend"):
+    if bucket.op in ("posv_blocktri", "blocktri_extend", "session_extend",
+                     "session_solve"):
         _, nbb, bb, _ = bucket.a_shape
         eyes = jnp.broadcast_to(jnp.eye(bb, dtype=dt), (nbb, bb, bb))
-        fa = jnp.stack([eyes, jnp.zeros((nbb, bb, bb), dt)])
-        if bucket.op == "blocktri_extend":
+        zeros = jnp.zeros((nbb, bb, bb), dt)
+        if bucket.op == "session_solve":
+            # identity window with its own factor: L = I, Wt = 0 is
+            # exactly factor(I-chain), so both sweeps and the residual
+            # operator are no-ops on fill slots
+            fa = jnp.stack([eyes, zeros, eyes, zeros])
+            return fa, jnp.zeros(bucket.b_shape, dtype=dt)
+        fa = jnp.stack([eyes, zeros])
+        if bucket.op in ("blocktri_extend", "session_extend"):
             # identity carry: extending the identity chain from L = I
             # factors every fill block to L = I exactly
             return fa, jnp.eye(bb, dtype=dt)
@@ -383,7 +447,7 @@ def crop(op: str, X, a_shape, b_shape):
         return X[: a_shape[0], : b_shape[1]]
     if op == "lstsq":
         return X[: a_shape[1], : b_shape[1]]
-    if op == "posv_blocktri":
+    if op in ("posv_blocktri", "session_solve"):
         return X[: a_shape[1], : a_shape[2], : b_shape[2]]
     if op == "posv_arrowhead":
         # X is the CHAIN half (nbb, bb, kb) — blocked, so plain slicing
@@ -392,7 +456,7 @@ def crop(op: str, X, a_shape, b_shape):
         nblocks, b = a_shape[1], a_shape[2]
         s = b_shape[0] - nblocks * b
         return X[:nblocks, :b, : b_shape[1] - s]
-    if op == "blocktri_extend":
+    if op in ("blocktri_extend", "session_extend"):
         # stacked (2, nbb, bb, bb) [L; Wt] back to the appended blocks
         return X[:, : a_shape[1], : a_shape[2], : a_shape[2]]
     # inv / chol_update / chol_downdate: square (n, n) principal window

@@ -467,17 +467,29 @@ class SolveEngine:
         ticket = Ticket(tid, t_enq)
         ticket.deadline_ms = (float(deadline_ms)
                               if deadline_ms is not None else None)
-        A = jnp.asarray(A)
+        if A is None and op != "session_close":
+            raise ValueError(f"{op} requires an A operand")
+        A = jnp.asarray(A) if A is not None else None
         B = jnp.asarray(B) if B is not None else None
-        if op not in batching.OPS:
+        if op not in batching.OPS and op not in batching.SESSION_OPS:
             raise ValueError(
-                f"unknown serve op {op!r}; expected one of {batching.OPS}"
+                f"unknown serve op {op!r}; expected one of "
+                f"{batching.OPS + batching.SESSION_OPS}"
             )
         if accuracy_tier != "balanced" and op not in api.TIER_OPS:
             raise ValueError(
                 f"accuracy_tier={accuracy_tier!r} is only defined for "
                 f"{api.TIER_OPS}, got op {op!r}"
             )
+        if op in batching.SESSION_OPS:
+            if factor_token is None:
+                raise ValueError(
+                    f"{op} requires factor_token= (the session id — "
+                    "docs/SERVING.md 'Streaming sessions')"
+                )
+            return self._submit_session(ticket, op, A, B,
+                                        str(factor_token), accuracy_tier,
+                                        t_enq)
         if op in batching.FACTOR_OPS:
             if factor_token is None:
                 raise ValueError(
@@ -914,6 +926,276 @@ class SolveEngine:
             client_op="blocktri_extend",
             sink=self._extend_sink(token, b, prior),
         )
+        return ticket
+
+    # ---- streaming sessions (docs/SERVING.md "Streaming sessions") ---------
+
+    def _submit_session(self, ticket: Ticket, op: str, A, B, token: str,
+                        tier: str, t_enq: float) -> Ticket:
+        """The session protocol submit path (serve/sessions.py drives it;
+        the wire contract is engine-level so sessions are first-class serve
+        ops, not a facade trick).  Residency resolves HERE, host-side,
+        exactly like `_submit_factor` — the compiled bucket programs never
+        see session ids, so session churn never recompiles anything.
+
+        Wire shapes: session_open / session_append take the window blocks
+        A = (2, nblocks, b, b) ([D; C] — C[:, 0] live for append, zeroed
+        host-side for open) and no B; session_solve takes the CURRENT
+        window A = (2, nblocks, b, b) plus B = (nblocks, b, nrhs) and the
+        engine composes the 4-stack [D; C; L; Wt] from the resident
+        factor; session_contract takes A = k (scalar — the number of
+        oldest blocks to drop) and returns the NEW head diagonal factor
+        block L_k (b, b) so the client can marginalize its window head
+        (D[0] ← L_k·L_kᵀ, C[0] ← 0 — models/blocktri.contract docstring);
+        session_close takes no operands and returns a 0/1 released flag.
+
+        Loudness contract: any request against an EVICTED session fails
+        with a tombstone-loud ``SessionEvicted`` error — the client must
+        re-seed via session_open (which clears the tombstone); a request
+        against a never-opened session fails as 'not open'.  Both are
+        failed Responses, never silent identity answers."""
+        if op in ("session_open", "session_append"):
+            if (A.ndim != 4 or A.shape[0] != 2
+                    or A.shape[2] != A.shape[3]):
+                raise ValueError(
+                    f"{op} needs A = (2, nblocks, b, b) window blocks "
+                    f"[diagonal, sub-diagonal], got {A.shape}"
+                )
+            if B is not None:
+                raise ValueError(
+                    f"{op} takes no B (the carry is resident), got "
+                    f"B {B.shape}"
+                )
+        elif op == "session_solve":
+            if (A.ndim != 4 or A.shape[0] != 2
+                    or A.shape[2] != A.shape[3]):
+                raise ValueError(
+                    f"session_solve needs A = (2, nblocks, b, b) — the "
+                    f"session's current [D; C] window — got {A.shape}"
+                )
+            if B is None or B.ndim != 3 or B.shape[:2] != A.shape[1:3]:
+                raise ValueError(
+                    f"session_solve needs B = (nblocks, b, nrhs) riding "
+                    f"A {A.shape}, got {None if B is None else B.shape}"
+                )
+        elif op == "session_contract":
+            if A.ndim != 0:
+                raise ValueError(
+                    f"session_contract needs a scalar A = k (blocks to "
+                    f"drop), got shape {A.shape}"
+                )
+            if B is not None:
+                raise ValueError("session_contract takes no B")
+        else:  # session_close
+            if A is not None or B is not None:
+                raise ValueError("session_close takes no operands")
+        self._start_trace(ticket, op, tier)
+
+        def lose(msg: str) -> Ticket:
+            self.executor.fail(
+                ticket, op,
+                msg + " (docs/SERVING.md 'Streaming sessions')", t_enq,
+            )
+            return ticket
+
+        def lose_missing() -> Ticket:
+            if self.factors.evicted(token):
+                return lose(
+                    f"SessionEvicted: session {token!r} lost its resident "
+                    "factor to cache pressure — re-seed the window with "
+                    "session_open"
+                )
+            return lose(f"session {token!r} is not open")
+
+        # host-side administrative ops: no compiled program, no device
+        # flops — the span chain collapses to admit -> cache_lookup ->
+        # respond under the 'session' trace kind
+        if op == "session_close":
+            if ticket.trace is not None:
+                ticket.trace.kind = "session"
+                ticket.trace.extend("admit")
+            released = self.factors.release(token)
+            if ticket.trace is not None:
+                ticket.trace.extend("cache_lookup")
+            return self._finish_host(
+                ticket, op, jnp.int32(1 if released else 0), t_enq)
+        if op == "session_contract":
+            if ticket.trace is not None:
+                ticket.trace.kind = "session"
+                ticket.trace.extend("admit")
+            ent = self.factors.lookup(token)
+            if ticket.trace is not None:
+                ticket.trace.extend("cache_lookup")
+            if ent is None:
+                return lose_missing()
+            if ent.kind != "session":
+                return lose(
+                    f"factor_token {token!r} holds a {ent.kind} factor; "
+                    "session ops need a session chain"
+                )
+            k = int(A)
+            nblocks = int(ent.meta["nblocks"])
+            if not 0 < k < nblocks:
+                return lose(
+                    f"session_contract k={k} must satisfy 0 < k < "
+                    f"nblocks={nblocks} (contracting the whole chain is "
+                    "session_close)"
+                )
+            L, Wt = ent.arrays[0], ent.arrays[1]
+            Lc, Wtc = blocktri.contract(L[None], Wt[None], k)
+            Lc, Wtc = Lc[0], Wtc[0]
+            self.factors.put(
+                token, "session", (Lc, Wtc, ent.arrays[2]),
+                {"b": int(ent.meta["b"]), "nblocks": nblocks - k,
+                 "dtype": ent.meta["dtype"],
+                 "dropped": int(ent.meta.get("dropped", 0)) + k},
+            )
+            # the new head diagonal factor block: exactly what the client
+            # needs to marginalize its window head (D[0] <- L_k·L_kᵀ)
+            return self._finish_host(ticket, op, Lc[0], t_enq)
+
+        try:
+            A = faultinject.tap(A, point="serve::ingest")
+        except faultinject.FaultInjected as e:
+            self.executor.fail(ticket, op, str(e), t_enq)
+            return ticket
+        dt = str(A.dtype)
+
+        if op == "session_open":
+            nblocks, b = int(A.shape[1]), int(A.shape[2])
+            # open IS the re-seed path: drop any prior incarnation and
+            # clear an eviction tombstone — the one sanctioned way back
+            # after a SessionEvicted failure
+            self.factors.release(token)
+            carry = jnp.eye(b, dtype=A.dtype)
+            A = A.at[1, 0].set(jnp.zeros((b, b), A.dtype))
+            bucket = batching.bucket_for(
+                "session_extend", tuple(A.shape), (b, b), dt, self.cfg)
+            if bucket is None:
+                return lose(
+                    f"no bucket for session window nblocks={nblocks} "
+                    f"b={b}: session ops have no oversize route"
+                )
+            pa, pb = batching.pad_operands("session_extend", A, carry,
+                                           bucket)
+            self._admit(
+                ticket, bucket, pa, pb, tuple(A.shape), (b, b), t_enq,
+                client_op="session_open",
+                sink=self._session_extend_sink(op, token, b),
+            )
+            return ticket
+
+        ent = self.factors.lookup(token)
+        if ent is None:
+            return lose_missing()
+        if ent.kind != "session":
+            return lose(
+                f"factor_token {token!r} holds a {ent.kind} factor; "
+                "session ops need a session chain"
+            )
+        if int(ent.meta["b"]) != int(A.shape[2]) or ent.meta["dtype"] != dt:
+            return lose(
+                f"operand {A.shape}/{dt} does not ride the resident "
+                f"session chain b={ent.meta['b']}/{ent.meta['dtype']} "
+                f"under token {token!r}"
+            )
+
+        if op == "session_append":
+            nblocks, b = int(A.shape[1]), int(A.shape[2])
+            carry = ent.arrays[2]
+            bucket = batching.bucket_for(
+                "session_extend", tuple(A.shape), (b, b), dt, self.cfg)
+            if bucket is None:
+                return lose(
+                    f"no bucket for session append nblocks={nblocks} "
+                    f"b={b}: session ops have no oversize route"
+                )
+            pa, pb = batching.pad_operands("session_extend", A, carry,
+                                           bucket)
+            self._admit(
+                ticket, bucket, pa, pb, tuple(A.shape), (b, b), t_enq,
+                client_op="session_append",
+                sink=self._session_extend_sink(op, token, b),
+            )
+            return ticket
+
+        # session_solve
+        nblocks, b = int(A.shape[1]), int(A.shape[2])
+        if int(ent.meta["nblocks"]) != nblocks:
+            return lose(
+                f"session_solve window has {nblocks} blocks but the "
+                f"resident chain under {token!r} has "
+                f"{ent.meta['nblocks']} — the client window is out of "
+                "sync (append/contract landed without updating it?)"
+            )
+        A4 = jnp.stack([A[0], A[1], ent.arrays[0], ent.arrays[1]])
+        bucket = batching.bucket_for(
+            "session_solve", tuple(A4.shape), tuple(B.shape), dt,
+            self.cfg, tier=tier)
+        if bucket is None:
+            return lose(
+                f"no bucket for session_solve nblocks={nblocks} b={b} "
+                f"nrhs={B.shape[2]}: session ops have no oversize route"
+            )
+        pa, pb = batching.pad_operands("session_solve", A4, B, bucket)
+        sink = (self._refine_sink("session_solve")
+                if bucket.tier == "guaranteed" else None)
+        self._admit(
+            ticket, bucket, pa, pb, tuple(A4.shape), tuple(B.shape),
+            t_enq, client_op="session_solve", sink=sink,
+        )
+        return ticket
+
+    def _session_extend_sink(self, op: str, token: str, b: int):
+        """Landing hook for session_open / session_append: install (open)
+        or concatenate (append) the landed (L, Wt) blocks and roll the
+        carry — `_extend_sink` with session bookkeeping.  Sessions are
+        STATEFUL, so a flagged extend fails the request LOUDLY even under
+        robust=None (the blocktri_extend path lets the engine's robust
+        knob decide; a silently uninstalled session suffix would desync
+        the client window from the resident chain forever)."""
+
+        def sink(x, extras, raw_info):
+            i = int(raw_info)
+            if i != 0:
+                return x, raw_info, (
+                    f"{op} flagged breakdown (info={i}, segment-relative "
+                    "to the submitted window blocks): the window is not "
+                    f"SPD-consistent; resident session chain {token!r} "
+                    "left unchanged" + (
+                        " (open failed — the session is closed)"
+                        if op == "session_open" else "")
+                )
+            L, Wt = x[0], x[1]
+            dropped = 0
+            ent = self.factors.peek(token)
+            if ent is not None and ent.kind == "session":
+                L = jnp.concatenate([ent.arrays[0], L], axis=0)
+                Wt = jnp.concatenate([ent.arrays[1], Wt], axis=0)
+                dropped = int(ent.meta.get("dropped", 0))
+            self.factors.put(
+                token, "session", (L, Wt, L[-1]),
+                {"b": b, "nblocks": int(L.shape[0]),
+                 "dtype": str(L.dtype), "dropped": dropped},
+            )
+            return x, raw_info, None
+
+        return sink
+
+    def _finish_host(self, ticket: Ticket, op: str, x, t_enq: float):
+        """Land a host-side administrative session op (contract/close):
+        no device dispatch happened, so there is no queue-wait/device
+        split — latency is pure host bookkeeping."""
+        t_land = time.monotonic()
+        ticket.response = Response(
+            request_id=ticket.request_id, op=op, ok=True, x=x, info=None,
+            error=None, bucket=None, batched=False,
+            latency_s=t_land - t_enq,
+        )
+        if ticket.trace is not None:
+            ticket.trace.extend("respond")
+            ticket.response.trace = ticket.trace
+        self.stats.record_request(op, t_land - t_enq, ok=True)
         return ticket
 
     def _update_sink(self, op: str, token: str, n: int, V):

@@ -184,7 +184,12 @@ class Executor:
             return (0,)
         if bucket.op == "posv_cached":
             return (1,)
-        if bucket.op in ("posv_cached_miss", "blocktri_extend"):
+        if bucket.op in ("posv_cached_miss", "blocktri_extend",
+                         "session_extend", "session_solve"):
+            # session_solve's 4-stack operand CONTAINS the FactorCache-
+            # resident (L, Wt) — donating it would let XLA scribble over
+            # the session's resident factor; the extend programs donate
+            # nothing for the blocktri_extend reasons above
             return ()
         if bucket.b_shape is not None:
             return (1,) if bucket.op == "posv" else ()

@@ -52,15 +52,20 @@ def _nbytes(arrays) -> int:
 @dataclasses.dataclass
 class FactorEntry:
     """One resident factor.  `kind` is 'dense' (arrays = (R,), upper
-    A = RᵀR) or 'blocktri' (arrays = (L, Wt, carry): the appended-so-far
+    A = RᵀR), 'blocktri' (arrays = (L, Wt, carry): the appended-so-far
     chain factor in the models/blocktri representation plus the running
-    (b, b) diagonal carry the next extend continues from).  `meta` is
-    engine bookkeeping (shapes/dtype used for request validation)."""
+    (b, b) diagonal carry the next extend continues from), or 'session'
+    (same arrays as 'blocktri', owned by the streaming-session protocol —
+    serve/sessions.py).  `meta` is engine bookkeeping (shapes/dtype used
+    for request validation).  `born` is the install position on the
+    cache's deterministic operation clock — eviction ages derive from it
+    (operations, not wall time, so the histogram is reproducible)."""
 
     kind: str
     arrays: tuple
     nbytes: int
     meta: dict
+    born: int = 0
 
 
 class FactorCache:
@@ -80,12 +85,22 @@ class FactorCache:
         self.installs = 0
         self.released = 0
         self.downdate_degrades = 0
+        # deterministic operation clock (ticks on lookup/put): eviction
+        # ages are measured on it so the age histogram is reproducible
+        # under test and load replay — wall clocks are not
+        self._op_clock = 0
+        # eviction-age histogram: key = smallest power-of-two upper bound
+        # on the evicted entry's age in cache operations (stringified for
+        # JSON), value = count.  Young evictions (small keys) mean the
+        # budget is thrashing; old ones mean honest retirement.
+        self._evict_age_hist: dict[str, int] = {}
 
     # ---- residency ---------------------------------------------------------
 
     def lookup(self, token: str) -> Optional[FactorEntry]:
         """Resident entry for `token` (refreshes LRU recency) or None.
         Counts a hit or a miss — call exactly once per request."""
+        self._op_clock += 1
         e = self._entries.get(token)
         if e is None:
             self.misses += 1
@@ -108,9 +123,13 @@ class FactorCache:
         """Install (or overwrite) a resident factor; evicts LRU entries
         until the pool fits the byte budget (never the entry just
         installed).  Returns the evicted tokens."""
+        self._op_clock += 1
         arrays = tuple(jax.device_put(a) for a in arrays)
+        prior = self._entries.get(token)
         e = FactorEntry(kind=kind, arrays=arrays, nbytes=_nbytes(arrays),
-                        meta=dict(meta))
+                        meta=dict(meta),
+                        born=(prior.born if prior is not None
+                              else self._op_clock))
         self._entries[token] = e
         self._entries.move_to_end(token)
         self._tombstones.discard(token)
@@ -118,9 +137,12 @@ class FactorCache:
         evicted = []
         while (self.resident_bytes() > self.budget_bytes
                and len(self._entries) > 1):
-            victim, _ = self._entries.popitem(last=False)
+            victim, v = self._entries.popitem(last=False)
             self._tombstones.add(victim)
             self.evictions += 1
+            age = max(0, self._op_clock - v.born)
+            key = str(1 << age.bit_length())
+            self._evict_age_hist[key] = self._evict_age_hist.get(key, 0) + 1
             evicted.append(victim)
         return evicted
 
@@ -167,4 +189,11 @@ class FactorCache:
             "bytes": self.resident_bytes(),
             "budget_bytes": self.budget_bytes,
             "hit_rate": (self.hits / lookups) if lookups else 1.0,
+            # per-entry byte sizes (token -> bytes) and the eviction-age
+            # histogram (power-of-two operation-age bucket -> count):
+            # the session eviction-pressure view (PR 19).  Additive keys —
+            # merge_snapshots folds only the scalar counters above, and
+            # the validator checks these only when present.
+            "entry_bytes": {t: e.nbytes for t, e in self._entries.items()},
+            "eviction_age_hist": dict(self._evict_age_hist),
         }

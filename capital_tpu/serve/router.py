@@ -75,17 +75,20 @@ class RouterTicket:
     contract is exactly this copy."""
 
     __slots__ = ("request_id", "op", "A", "B", "tier", "deadline_ms",
-                 "t_enq", "replica_id", "attempts", "response", "_event")
+                 "affinity", "t_enq", "replica_id", "attempts", "response",
+                 "_event")
 
     def __init__(self, request_id: int, op: str, A, B,
                  tier: str = "balanced",
-                 deadline_ms: Optional[float] = None):
+                 deadline_ms: Optional[float] = None,
+                 affinity: Optional[str] = None):
         self.request_id = request_id
         self.op = op
         self.A = A
         self.B = B
         self.tier = tier
         self.deadline_ms = deadline_ms
+        self.affinity = affinity
         self.t_enq = time.monotonic()
         self.replica_id: Optional[str] = None  # current owner
         self.attempts = 0
@@ -137,7 +140,8 @@ def _rung(ladder, v: int) -> Optional[int]:
 
 
 def bucket_signature(op: str, a_shape, b_shape, dtype: str,
-                     ladders: dict, tier: str = "balanced") -> tuple:
+                     ladders: dict, tier: str = "balanced",
+                     affinity: Optional[str] = None) -> tuple:
     """The affinity key: the (op, padded-shape) class this request batches
     into, derived from the same ladders the engine buckets with.  Oversize
     requests key on their exact shape — each oversize shape is its own
@@ -145,7 +149,20 @@ def bucket_signature(op: str, a_shape, b_shape, dtype: str,
     answer there too.  The accuracy tier joins the key because tiered
     requests compile (and batch in) their own bucket programs — affinity
     must steer a guaranteed request to the replica whose cache holds the
-    guaranteed executable, not merely the same-shape balanced one."""
+    guaranteed executable, not merely the same-shape balanced one.
+
+    An explicit `affinity` token DOMINATES the signature: every request
+    carrying the same token keys identically, regardless of op, shape,
+    dtype or tier.  This is the session-sticky contract (docs/SERVING.md
+    'Streaming sessions'): a session's resident factor lives in exactly
+    one replica's FactorCache, so ALL of its traffic — open, append,
+    solve at any tier, contract, close, with their different operand
+    shapes — must single-home to that replica.  Rendezvous hashing keeps
+    the stickiness membership-stable: a replica death remaps only the
+    sessions it owned (those re-seed loudly via SessionEvicted); every
+    other session stays put."""
+    if affinity is not None:
+        return ("affinity", str(affinity))
     n_r = _rung(ladders["buckets"],
                 a_shape[1] if op == "lstsq" else a_shape[0])
     k_r = (_rung(ladders["nrhs_buckets"], b_shape[1])
@@ -233,7 +250,8 @@ class Router:
 
     def submit(self, op: str, A, B=None, *,
                accuracy_tier: str = "balanced",
-               deadline_ms: Optional[float] = None) -> RouterTicket:
+               deadline_ms: Optional[float] = None,
+               affinity: Optional[str] = None) -> RouterTicket:
         """Dispatch one request to a healthy replica; raises RuntimeError
         when none admits (every replica dead or draining) — admission
         control, not silent queueing.  Work already admitted is never
@@ -241,13 +259,20 @@ class Router:
 
         `accuracy_tier` rides the ticket (and the re-dispatch copy) to the
         replica's engine.submit — tier validation is the engine's job, so
-        an invalid tier lands as a failed Result, not a router raise."""
+        an invalid tier lands as a failed Result, not a router raise.
+
+        `affinity` is the session-sticky token (typically the session id):
+        under bucket_affinity it dominates the rendezvous signature so
+        every request carrying it — regardless of op/shape/tier — routes
+        to the one replica holding that session's resident factor (see
+        bucket_signature)."""
         with self._lock:
             rid = self._next_id
             self._next_id += 1
             t = RouterTicket(rid, op, np.asarray(A),
                              np.asarray(B) if B is not None else None,
-                             tier=accuracy_tier, deadline_ms=deadline_ms)
+                             tier=accuracy_tier, deadline_ms=deadline_ms,
+                             affinity=affinity)
             st = self._pick(t)
             if st is None:
                 raise RuntimeError(
@@ -465,7 +490,7 @@ class Router:
         if self.cfg.policy == "bucket_affinity" and self._ladders:
             sig = bucket_signature(
                 t.op, t.A.shape, t.B.shape if t.B is not None else None,
-                t.A.dtype, self._ladders, tier=t.tier,
+                t.A.dtype, self._ladders, tier=t.tier, affinity=t.affinity,
             )
             rid = _rendezvous(sig, sorted(st.replica.replica_id
                                           for st in healthy))

@@ -1,0 +1,353 @@
+"""Streaming state-space sessions: the client half of the session protocol.
+
+A *session* is a long-lived solve context whose blocktri chain factor
+stays resident in the engine's FactorCache (token = session id) while the
+client streams blocks through a sliding window (docs/SERVING.md
+"Streaming sessions"):
+
+* ``open``     — seed the resident chain from the initial window blocks
+  (engine op ``session_open``; one O(nblocks·b³) factorization).
+* ``append``   — extend the resident factor by the NEW blocks only
+  (``session_append`` riding ``models/blocktri.extend`` from the stored
+  carry): O(new-blocks), never O(window).
+* ``solve``    — forward/backward sweeps against the resident factor
+  (``session_solve``), honoring the per-request ``accuracy_tier``
+  ('guaranteed' refines against the session's own resident factor).
+* ``downdate`` / ``contract`` — drop the k OLDEST blocks
+  (``session_contract`` riding ``models/blocktri.contract``): a pure
+  slice of the resident factor, bitwise-equal to refactoring the
+  truncated chain.  ``append`` + ``contract`` give O(new-blocks) sliding
+  windows.
+* ``close``    — release the resident factor.
+
+The SessionManager mirrors the resident chain with a host-side window
+matrix (D, C as NumPy arrays) so every ``solve`` can ship the CURRENT
+window operand the guaranteed tier computes residuals against.  The one
+subtle piece of bookkeeping lives at ``contract`` time: the contracted
+factor represents the MARGINAL precision of the surviving window — its
+head diagonal is L_k·L_kᵀ and its head coupling is zero (see the
+``models/blocktri.contract`` docstring) — so the manager rebuilds its
+window head from the new head factor block the engine returns:
+``D[0] ← L_k·L_kᵀ``, ``C[0] ← 0``.  Skipping that update would desync
+the window from the factor and fail the engine's out-of-sync check on
+the next solve.
+
+Loudness contract: when the resident factor was EVICTED under cache
+pressure, the engine fails the request with a tombstone-loud
+``SessionEvicted:`` error; the manager converts it into the typed
+:class:`SessionEvicted` exception (dropping its local mirror — the
+state is gone) so clients re-seed explicitly via :meth:`open`, the one
+sanctioned path back (it clears the tombstone).  Re-opening a known
+session id counts as a ``reseed`` in the session stats.
+
+Counters accumulate here and surface through
+:meth:`SessionManager.emit_session_stats` as ONE ``serve:session_stats``
+ledger record (obs.ledger.validate_session_stats validates it; ``obs
+serve-report --min-session-hit-rate / --max-reseeds`` gate it).  The
+session hit-rate is the residency story's whole justification: a miss
+means a full O(window) re-seed where a hit was O(new-blocks).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+from capital_tpu.serve.executor import Response
+
+#: ever-incremented schema tag for the session_stats block.
+SESSION_STATS_SCHEMA = 1
+
+
+class SessionEvicted(RuntimeError):
+    """The session's resident factor was evicted under cache pressure.
+
+    Raised (never swallowed) by SessionManager methods when the engine
+    answers with a tombstone-loud ``SessionEvicted:`` failure.  The local
+    window mirror is dropped before raising — the only way forward is
+    :meth:`SessionManager.open` with a fresh window (counted as a
+    reseed)."""
+
+    def __init__(self, sid: str, error: str):
+        super().__init__(error)
+        self.sid = sid
+
+
+@dataclasses.dataclass
+class _SessionState:
+    """Host-side mirror of one resident session chain."""
+
+    b: int
+    dtype: np.dtype
+    D: np.ndarray        # (nblocks, b, b) current window diagonal blocks
+    C: np.ndarray        # (nblocks, b, b) current window couplings; C[0] == 0
+    dropped: int = 0     # blocks contracted away since open (whole-chain)
+    appends: int = 0
+    solves: int = 0
+    contracts: int = 0
+
+    @property
+    def nblocks(self) -> int:
+        return int(self.D.shape[0])
+
+
+def _check_blocks(name: str, D, C, b: Optional[int] = None):
+    D = np.asarray(D)
+    C = np.asarray(C)
+    if D.ndim != 3 or D.shape[1] != D.shape[2]:
+        raise ValueError(f"{name}: D must be (nblocks, b, b), got {D.shape}")
+    if C.shape != D.shape:
+        raise ValueError(
+            f"{name}: C must ride D {D.shape}, got {C.shape}")
+    if b is not None and D.shape[1] != b:
+        raise ValueError(
+            f"{name}: block size {D.shape[1]} does not match the session's "
+            f"b={b}")
+    return D, C
+
+
+class SessionManager:
+    """open / append / solve / downdate / close over a SolveEngine.
+
+    Synchronous by design: each method submits one engine request and
+    drains it (engine.solve), so the local window mirror and the resident
+    factor move in lockstep — the protocol's correctness depends on that
+    ordering, not on throughput (batched session throughput comes from
+    many sessions, not from pipelining one).
+
+    Methods return the engine's :class:`Response` (callers check ``ok``)
+    except when the resident factor was evicted, which raises
+    :class:`SessionEvicted` (see module docstring)."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self._sessions: dict[str, _SessionState] = {}
+        self._known: set[str] = set()   # ever-opened ids: reseed detection
+        self.opens = 0
+        self.reseeds = 0
+        self.appends = 0
+        self.solves = 0
+        self.contracts = 0
+        self.closes = 0
+        self.failures = 0               # non-eviction failed responses
+        self.evicted_failures = 0       # SessionEvicted conversions
+        self.hits = 0                   # resident requests that found state
+        self.misses = 0                 # == evicted_failures (see hit_rate)
+        self.blocks_appended = 0        # open + append blocks, whole-run
+        self.blocks_dropped = 0         # contracted blocks, whole-run
+
+    # ---- protocol ----------------------------------------------------------
+
+    def open(self, sid: str, D, C, *,
+             deadline_ms: Optional[float] = None) -> Response:
+        """Seed (or RE-seed) session `sid` from the initial window blocks
+        D, C = (nblocks, b, b).  C[0] is ignored (zeroed — the chain head
+        has no predecessor).  Re-opening a known id is the sanctioned
+        recovery from :class:`SessionEvicted` and counts as a reseed."""
+        sid = str(sid)
+        D, C = _check_blocks("session open", D, C)
+        b = int(D.shape[1])
+        A = np.stack([D, C]).astype(D.dtype, copy=False)
+        resp = self.engine.solve("session_open", A, factor_token=sid,
+                                 deadline_ms=deadline_ms)
+        self.opens += 1
+        if sid in self._known:
+            self.reseeds += 1
+        self._known.add(sid)
+        if not resp.ok:
+            self.failures += 1
+            self._sessions.pop(sid, None)
+            return resp
+        C = np.array(C, copy=True)
+        C[0] = 0
+        self._sessions[sid] = _SessionState(
+            b=b, dtype=D.dtype, D=np.array(D, copy=True), C=C)
+        self.blocks_appended += int(D.shape[0])
+        return resp
+
+    def append(self, sid: str, D, C, *,
+               deadline_ms: Optional[float] = None) -> Response:
+        """Extend session `sid` by the NEW blocks D, C = (k, b, b) —
+        C[0] is LIVE (it couples the first new block to the current
+        window tail).  O(k) work against the resident carry; the window
+        mirror grows only when the engine confirms the factor did."""
+        sid = str(sid)
+        s = self._state(sid)
+        D, C = _check_blocks("session append", D, C, s.b)
+        A = np.stack([D, C]).astype(s.dtype, copy=False)
+        resp = self.engine.solve("session_append", A, factor_token=sid,
+                                 deadline_ms=deadline_ms)
+        if not resp.ok:
+            return self._lose(sid, resp)
+        self.hits += 1
+        self.appends += 1
+        s.appends += 1
+        s.D = np.concatenate([s.D, np.asarray(D, dtype=s.dtype)])
+        s.C = np.concatenate([s.C, np.asarray(C, dtype=s.dtype)])
+        self.blocks_appended += int(D.shape[0])
+        return resp
+
+    def solve(self, sid: str, B, *, accuracy_tier: str = "balanced",
+              deadline_ms: Optional[float] = None) -> Response:
+        """Solve A_window · X = B against the resident factor.  B =
+        (nblocks, b, nrhs) rides the CURRENT window; the engine composes
+        the [D; C; L; Wt] program operand from the resident chain, so
+        the wire cost is one RHS — never the factor."""
+        sid = str(sid)
+        s = self._state(sid)
+        B = np.asarray(B, dtype=s.dtype)
+        if B.ndim != 3 or B.shape[0] != s.nblocks or B.shape[1] != s.b:
+            raise ValueError(
+                f"session solve: B must be (nblocks={s.nblocks}, "
+                f"b={s.b}, nrhs), got {B.shape}")
+        A = np.stack([s.D, s.C])
+        resp = self.engine.solve("session_solve", A, B, factor_token=sid,
+                                 accuracy_tier=accuracy_tier,
+                                 deadline_ms=deadline_ms)
+        if not resp.ok:
+            return self._lose(sid, resp)
+        self.hits += 1
+        self.solves += 1
+        s.solves += 1
+        return resp
+
+    def contract(self, sid: str, k: int) -> Response:
+        """Drop the k OLDEST blocks (sliding-window downdate).  The
+        resident factor contracts by a pure slice; the window mirror
+        slides and rebuilds its head from the new head factor block the
+        engine returns: D[0] ← L_k·L_kᵀ, C[0] ← 0 (the marginal window
+        precision — models/blocktri.contract)."""
+        sid = str(sid)
+        s = self._state(sid)
+        k = int(k)
+        if not 0 < k < s.nblocks:
+            raise ValueError(
+                f"session contract: k={k} must satisfy 0 < k < "
+                f"nblocks={s.nblocks} (dropping everything is close())")
+        resp = self.engine.solve("session_contract", k, factor_token=sid)
+        if not resp.ok:
+            return self._lose(sid, resp)
+        Lk = np.asarray(resp.x)
+        self.hits += 1
+        self.contracts += 1
+        s.contracts += 1
+        s.D = np.array(s.D[k:], copy=True)
+        s.C = np.array(s.C[k:], copy=True)
+        s.D[0] = Lk @ Lk.T
+        s.C[0] = 0
+        s.dropped += k
+        self.blocks_dropped += k
+        return resp
+
+    #: protocol alias — `downdate` is the session-protocol name for the
+    #: sliding-window contract (symmetry with chol_downdate).
+    downdate = contract
+
+    def close(self, sid: str) -> Response:
+        """Release the resident factor and the local mirror.  Closing an
+        already-gone session is a no-op success (the released flag in
+        ``response.x`` says whether a factor was actually resident)."""
+        sid = str(sid)
+        resp = self.engine.solve("session_close", None, factor_token=sid)
+        self._sessions.pop(sid, None)
+        self.closes += 1
+        return resp
+
+    # ---- window / pivot bookkeeping ---------------------------------------
+
+    def window(self, sid: str):
+        """Copies of the session's current (D, C) window blocks — the
+        matrix every solve answers for (residual seam for tests)."""
+        s = self._state(sid)
+        return np.array(s.D, copy=True), np.array(s.C, copy=True)
+
+    def is_open(self, sid: str) -> bool:
+        return str(sid) in self._sessions
+
+    def pivot_offset(self, sid: str) -> int:
+        """Rows preceding the CURRENT window head in whole-chain
+        coordinates (counting every block ever streamed, including
+        contracted ones): dropped · b."""
+        s = self._state(sid)
+        return s.dropped * s.b
+
+    def segment_offset(self, sid: str) -> int:
+        """Whole-chain row offset of the NEXT appended segment — equal to
+        the offset of the most recent segment when that append FAILED
+        (the window did not grow), which is exactly when it is needed."""
+        s = self._state(sid)
+        return (s.dropped + s.nblocks) * s.b
+
+    def absolute_pivot(self, sid: str, info) -> int:
+        """Map a segment-relative breakdown pivot (1-based ``info`` from
+        a failed open/append) to the whole chain: every block ever
+        streamed through the session counts, contracted ones included."""
+        return self.segment_offset(sid) + int(info)
+
+    # ---- internals ---------------------------------------------------------
+
+    def _state(self, sid: str) -> _SessionState:
+        s = self._sessions.get(str(sid))
+        if s is None:
+            raise KeyError(
+                f"session {sid!r} is not open here — open() it first "
+                "(after SessionEvicted, re-open with a fresh window)")
+        return s
+
+    def _lose(self, sid: str, resp: Response) -> Response:
+        """Failed-response triage: eviction raises the typed exception
+        (dropping the mirror — the resident state is gone); everything
+        else returns the failed Response untouched."""
+        if resp.error and resp.error.startswith("SessionEvicted:"):
+            self.misses += 1
+            self.evicted_failures += 1
+            self._sessions.pop(str(sid), None)
+            raise SessionEvicted(sid, resp.error)
+        self.failures += 1
+        return resp
+
+    # ---- stats -------------------------------------------------------------
+
+    def stats(self) -> dict:
+        """The session_stats counter block (see emit_session_stats)."""
+        resolved = self.hits + self.misses
+        return {
+            "schema_version": SESSION_STATS_SCHEMA,
+            "opens": self.opens,
+            "reseeds": self.reseeds,
+            "appends": self.appends,
+            "solves": self.solves,
+            "contracts": self.contracts,
+            "closes": self.closes,
+            "failures": self.failures,
+            "evicted_failures": self.evicted_failures,
+            "hits": self.hits,
+            "misses": self.misses,
+            # hit-rate over RESIDENT requests (append/solve/contract):
+            # a miss is an evicted factor — priced as a full re-seed
+            "hit_rate": (self.hits / resolved) if resolved else 1.0,
+            "sessions_open": len(self._sessions),
+            "sessions_known": len(self._known),
+            "blocks_appended": self.blocks_appended,
+            "blocks_dropped": self.blocks_dropped,
+        }
+
+    def emit_session_stats(self, path: Optional[str] = None, *,
+                           grid=None, config=None, **extra) -> dict:
+        """Assemble (and append, when `path` is given) ONE ledger record
+        carrying the session counters — kind 'serve:session_stats', same
+        manifest discipline as every other ledger row
+        (obs.ledger.validate_session_stats)."""
+        from capital_tpu.obs import ledger
+
+        rec = ledger.record(
+            "serve:session_stats",
+            ledger.manifest(grid=grid, config=config or self.engine.cfg),
+            session_stats=self.stats(),
+            **extra,
+        )
+        if path:
+            ledger.append(path, rec)
+        return rec

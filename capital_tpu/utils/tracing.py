@@ -208,6 +208,16 @@ PHASE_REGISTRY: tuple[str, ...] = (
     # x_T = Z − Z_B·x_S.  Emits fire outside every scan (the chain scans
     # live inside blocktri) — the BT::factor rationale.
     "AH::schur", "AH::border",
+    # streaming state-space sessions (serve/sessions.py, docs/SERVING.md
+    # "Streaming sessions").  SS::extend wraps the session open/append
+    # chain-extension program, SS::solve the resident-factor sweep
+    # program; both price the whole chain OUTSIDE the interior
+    # blocktri scans (the BT::factor rationale), and the interior
+    # blocktri calls trace muted() so the work is priced exactly once —
+    # under the SS::* tag the session stats attribute by.  The
+    # session_contract/close ops are host-side (a pure factor slice plus
+    # residency bookkeeping) and execute zero device flops: no phase.
+    "SS::extend", "SS::solve",
 )
 _PHASE_SET: set[str] = set(PHASE_REGISTRY)
 

@@ -1,0 +1,619 @@
+"""Streaming state-space session tests (PR 19 acceptance).
+
+The properties pinned here, mapped to the issue's criteria:
+
+* ``models/blocktri.contract`` is a PURE SLICE of the factor, bitwise
+  equal to refactoring the truncated chain (extend-replay from the
+  retained carry) across (nblocks, b) ladders and both impls, and the
+  contracted factor answers for the MARGINALIZED window matrix — head
+  diagonal L_k·L_kᵀ, head coupling zero (TestContract);
+* extend-after-contract roundtrips: sliding the window never perturbs
+  the surviving factor blocks, so append-then-contract and
+  contract-then-append land bitwise-identical state (TestContract);
+* the serve protocol end to end: open / append / solve (all three
+  accuracy tiers, residuals against the window mirror's dense assembly)
+  / contract / close through a real SolveEngine, steady-state cycles at
+  zero recompiles, and whole-chain pivot bookkeeping under breakdown —
+  a flagged append leaves the resident chain untouched and
+  ``absolute_pivot`` maps the segment-relative info to whole-stream
+  coordinates, contracted blocks included (TestSessionProtocol);
+* eviction is tombstone-LOUD: cache pressure converts the next session
+  request into the typed SessionEvicted raise, drops the local mirror,
+  and re-open is the one sanctioned reseed path (TestEviction);
+* FactorCache stats carry the per-entry byte ledger and the
+  power-of-two eviction-age histogram on the deterministic op clock,
+  with `born` preserved across overwrites (TestFactorCacheStats);
+* serve:session_stats records validate (accept + reject seams) and
+  `obs serve-report --min-session-hit-rate / --max-reseeds` gate them,
+  failing LOUDLY when no record carries the block (TestSessionLedger,
+  TestServeReportGates);
+* session-sticky routing: the affinity token dominates the bucket
+  signature, and rendezvous hashing remaps ONLY the dead replica's
+  sessions on membership change (TestAffinityRouting).
+
+Runs on the conftest CPU rig (x64 on).  Engine tests keep blocks tiny
+(b=4) so every executable compiles in well under a second; the long
+contract ladder is slow-marked.
+"""
+
+import re
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from capital_tpu.models import blocktri
+from capital_tpu.obs import __main__ as obs_main
+from capital_tpu.obs import ledger
+from capital_tpu.serve import ServeConfig, SolveEngine
+from capital_tpu.serve import router as router_mod
+from capital_tpu.serve.factorcache import FactorCache
+from capital_tpu.serve.sessions import SessionEvicted, SessionManager
+
+S_CFG = ServeConfig(
+    buckets=(8,),
+    rows_buckets=(32,),
+    nrhs_buckets=(2,),
+    max_batch=2,
+    max_delay_s=10.0,
+    nblocks_buckets=(2, 4),
+    block_buckets=(4,),
+)
+
+
+def _chain(rng, nblocks, b, dtype=np.float64, live_head=False):
+    """One unbatched SPD window (the session wire shape): gram/b + 3I
+    diagonals, 0.3/sqrt(b) couplings.  `live_head` keeps C[0] — the
+    append segment contract (it couples into the previous window)."""
+    G = rng.standard_normal((nblocks, b, b))
+    D = G @ G.transpose(0, 2, 1) / b + 3.0 * np.eye(b)
+    C = 0.3 / np.sqrt(b) * rng.standard_normal((nblocks, b, b))
+    if not live_head:
+        C[0] = 0.0
+    return D.astype(dtype), C.astype(dtype)
+
+
+def _np_dense(D, C):
+    """NumPy-side dense assembly of one window — independent of the code
+    under test (the test_blocktri discipline)."""
+    nblocks, b = D.shape[0], D.shape[1]
+    n = nblocks * b
+    A = np.zeros((n, n), dtype=np.float64)
+    for i in range(nblocks):
+        sl = slice(i * b, (i + 1) * b)
+        A[sl, sl] = D[i]
+        if i:
+            up = slice((i - 1) * b, i * b)
+            A[sl, up] = C[i]
+            A[up, sl] = C[i].T
+    return A
+
+
+def _mgr(cfg=S_CFG):
+    eng = SolveEngine(cfg=cfg)
+    return eng, SessionManager(eng)
+
+
+# ---------------------------------------------------------------------------
+# models/blocktri.contract: pure slice, bitwise replay, marginal window
+# ---------------------------------------------------------------------------
+
+
+class TestContract:
+    @pytest.mark.parametrize("nblocks,b,k", [(4, 4, 1), (6, 4, 2),
+                                             (4, 8, 3)])
+    @pytest.mark.parametrize("impl,dtype", [("xla", np.float64),
+                                            ("pallas", np.float32)])
+    def test_bitwise_vs_truncated_refactor(self, nblocks, b, k, impl,
+                                           dtype):
+        # the contract docstring's claim, both impls: re-extending the
+        # truncated chain — head coupling LIVE, carried from the retained
+        # L_{k-1} — reproduces every block the contract kept, bit for bit
+        rng = np.random.default_rng(40)
+        D, C = _chain(rng, nblocks, b, dtype=dtype)
+        Dj, Cj = jnp.asarray(D)[None], jnp.asarray(C)[None]
+        L, Wt, info = blocktri.factor(Dj, Cj, impl=impl)
+        assert int(info[0]) == 0
+        Lc, Wtc = blocktri.contract(L, Wt, k)
+        Lr, Wtr, infor = blocktri.extend(Dj[:, k:], Cj[:, k:],
+                                         L[:, k - 1], impl=impl)
+        assert int(infor[0]) == 0
+        np.testing.assert_array_equal(np.asarray(Lr), np.asarray(Lc))
+        np.testing.assert_array_equal(np.asarray(Wtr), np.asarray(Wtc))
+
+    def test_contract_is_pure_slice(self):
+        rng = np.random.default_rng(41)
+        D, C = _chain(rng, 5, 4)
+        L, Wt, _ = blocktri.factor(jnp.asarray(D)[None],
+                                   jnp.asarray(C)[None], impl="xla")
+        Lc, Wtc = blocktri.contract(L, Wt, 2)
+        np.testing.assert_array_equal(np.asarray(Lc), np.asarray(L)[:, 2:])
+        np.testing.assert_array_equal(np.asarray(Wtc),
+                                      np.asarray(Wt)[:, 2:])
+
+    def test_contract_k_validation(self):
+        L = jnp.zeros((1, 4, 3, 3))
+        for k in (4, 5, -1):
+            with pytest.raises(ValueError, match="contract"):
+                blocktri.contract(L, L, k)
+        # k=0 is the identity slide — allowed, returns the factor as-is
+        Lc, Wtc = blocktri.contract(L, L, 0)
+        assert Lc.shape == L.shape and Wtc.shape == L.shape
+
+    def test_contracted_factor_solves_marginal_window(self):
+        # the bookkeeping every session client must do at slide time:
+        # the contracted factor answers for the MARGINALIZED window —
+        # head diagonal L_k·L_kᵀ, head coupling zero — NOT the original
+        # trailing window (which still couples into dropped blocks)
+        rng = np.random.default_rng(42)
+        nblocks, b, k, nrhs = 5, 4, 2, 3
+        D, C = _chain(rng, nblocks, b)
+        L, Wt, _ = blocktri.factor(jnp.asarray(D)[None],
+                                   jnp.asarray(C)[None], impl="xla")
+        Lc, Wtc = blocktri.contract(L, Wt, k)
+        Dw, Cw = D[k:].copy(), C[k:].copy()
+        Lk = np.asarray(L)[0, k]
+        Dw[0] = Lk @ Lk.T
+        Cw[0] = 0.0
+        B = rng.standard_normal((nblocks - k, b, nrhs))
+        X = blocktri.solve(Lc, Wtc, jnp.asarray(B)[None], impl="xla")
+        n = (nblocks - k) * b
+        ref = np.linalg.solve(_np_dense(Dw, Cw), B.reshape(n, nrhs))
+        np.testing.assert_allclose(np.asarray(X)[0].reshape(n, nrhs),
+                                   ref, rtol=0, atol=1e-11)
+
+    def test_extend_after_contract_roundtrip(self):
+        # sliding never perturbs survivors: extending the CONTRACTED
+        # factor and contracting the EXTENDED factor land bitwise on the
+        # same state (both orders append from the identical carry)
+        rng = np.random.default_rng(43)
+        nblocks, b, k, m = 4, 4, 2, 2
+        D, C = _chain(rng, nblocks, b)
+        Dm, Cm = _chain(rng, m, b, live_head=True)
+        Dj, Cj = jnp.asarray(D)[None], jnp.asarray(C)[None]
+        Dmj, Cmj = jnp.asarray(Dm)[None], jnp.asarray(Cm)[None]
+        L, Wt, _ = blocktri.factor(Dj, Cj, impl="xla")
+        Lx, Wtx, info = blocktri.extend(Dmj, Cmj, L[:, -1], impl="xla")
+        assert int(info[0]) == 0
+        # contract-then-extend
+        Lc, Wtc = blocktri.contract(L, Wt, k)
+        a_L = np.concatenate([np.asarray(Lc), np.asarray(Lx)], axis=1)
+        a_Wt = np.concatenate([np.asarray(Wtc), np.asarray(Wtx)], axis=1)
+        # extend-then-contract
+        Lf = jnp.concatenate([L, Lx], axis=1)
+        Wtf = jnp.concatenate([Wt, Wtx], axis=1)
+        b_L, b_Wt = blocktri.contract(Lf, Wtf, k)
+        np.testing.assert_array_equal(a_L, np.asarray(b_L))
+        np.testing.assert_array_equal(a_Wt, np.asarray(b_Wt))
+
+    @pytest.mark.slow
+    def test_contract_ladder_long_chain(self):
+        # nblocks=64 with repeated slides — the flagship bench geometry
+        # shape family (excluded from tier-1; `make bench-session` gates
+        # the wall-clock half)
+        rng = np.random.default_rng(44)
+        D, C = _chain(rng, 64, 8)
+        Dj, Cj = jnp.asarray(D)[None], jnp.asarray(C)[None]
+        L, Wt, _ = blocktri.factor(Dj, Cj, impl="xla")
+        for k in (1, 8, 16):
+            Lc, Wtc = blocktri.contract(L, Wt, k)
+            Lr, Wtr, info = blocktri.extend(Dj[:, k:], Cj[:, k:],
+                                            L[:, k - 1], impl="xla")
+            assert int(info[0]) == 0
+            np.testing.assert_array_equal(np.asarray(Lr), np.asarray(Lc))
+            np.testing.assert_array_equal(np.asarray(Wtr),
+                                          np.asarray(Wtc))
+
+
+# ---------------------------------------------------------------------------
+# serve protocol end to end
+# ---------------------------------------------------------------------------
+
+
+class TestSessionProtocol:
+    def test_lifecycle_residuals_all_tiers(self):
+        rng = np.random.default_rng(50)
+        eng, mgr = _mgr()
+        nblocks, b, nrhs = 4, 4, 2
+        D, C = _chain(rng, nblocks, b)
+        assert mgr.open("s", D, C).ok
+
+        def check(tier, tol):
+            Dw, Cw = mgr.window("s")
+            B = rng.standard_normal((Dw.shape[0], b, nrhs))
+            r = mgr.solve("s", B, accuracy_tier=tier)
+            assert r.ok, r.error
+            n = Dw.shape[0] * b
+            ref = np.linalg.solve(_np_dense(Dw, Cw), B.reshape(n, nrhs))
+            err = np.abs(np.float64(np.asarray(r.x)).reshape(n, nrhs)
+                         - ref).max()
+            assert err < tol * np.abs(ref).max(), (tier, err)
+
+        for tier, tol in (("balanced", 1e-9), ("guaranteed", 1e-9),
+                          ("fast", 5e-4)):
+            check(tier, tol)
+        # slide: contract 2, solve the marginalized 2-window, append 2
+        # back to a 4-window, solve again — the full steady-state cycle
+        assert mgr.contract("s", 2).ok
+        check("balanced", 1e-9)
+        Da, Ca = _chain(rng, 2, b, live_head=True)
+        assert mgr.append("s", Da, Ca).ok
+        check("balanced", 1e-9)
+        check("guaranteed", 1e-9)
+        r = mgr.close("s")
+        assert r.ok and int(np.asarray(r.x)) == 1
+        assert not mgr.is_open("s")
+        st = mgr.stats()
+        assert st["misses"] == 0 and st["hit_rate"] == 1.0
+        assert st["blocks_appended"] == 6 and st["blocks_dropped"] == 2
+
+    def test_steady_state_cycles_zero_recompile(self):
+        # session residency is host-side state keyed by session id:
+        # after the first full cycle compiles its two programs, further
+        # cycles — and brand-new sessions — must never compile again
+        rng = np.random.default_rng(51)
+        eng, mgr = _mgr()
+        nblocks, b, nrhs = 4, 4, 2
+
+        def cycle(sid):
+            Da, Ca = _chain(rng, 2, b, live_head=True)
+            assert mgr.append(sid, Da, Ca).ok
+            assert mgr.contract(sid, 2).ok
+            B = rng.standard_normal((nblocks, b, nrhs))
+            assert mgr.solve(sid, B).ok
+
+        D, C = _chain(rng, nblocks, b)
+        assert mgr.open("s1", D, C).ok
+        cycle("s1")
+        c0 = eng.cache_stats()["compiles"]
+        for _ in range(3):
+            cycle("s1")
+        D, C = _chain(rng, nblocks, b)
+        assert mgr.open("s2", D, C).ok
+        cycle("s2")
+        assert eng.cache_stats()["compiles"] == c0
+
+    def test_pivot_offset_bookkeeping_under_breakdown(self):
+        # a flagged append fails LOUDLY, leaves the resident chain AND
+        # the window mirror untouched, and reports a segment-relative
+        # pivot the manager maps to whole-chain coordinates — contracted
+        # blocks included
+        rng = np.random.default_rng(52)
+        eng, mgr = _mgr()
+        b = 4
+        D, C = _chain(rng, 2, b)
+        assert mgr.open("s", D, C).ok
+        assert mgr.segment_offset("s") == 2 * b
+        # poison the SECOND appended block: clean negative diagonal,
+        # zeroed incoming coupling, so its Schur complement is the block
+        Da, Ca = _chain(rng, 2, b, live_head=True)
+        Da[1] = np.diag([1.0, 1.0, -5.0, 1.0])
+        Ca[1] = 0.0
+        r = mgr.append("s", Da, Ca)
+        assert not r.ok
+        assert "flagged breakdown" in r.error
+        assert "left unchanged" in r.error
+        local = int(re.search(r"info=(\d+)", r.error).group(1))
+        # the xla scan is block-exact: the pivot lands inside appended
+        # block 1 (1-based local rows b+1 .. 2b)
+        assert b + 1 <= local <= 2 * b
+        assert 3 * b + 1 <= mgr.absolute_pivot("s", local) <= 4 * b
+        # resident chain unchanged: the window did not grow, solves work
+        Dw, _ = mgr.window("s")
+        assert Dw.shape[0] == 2
+        assert mgr.segment_offset("s") == 2 * b
+        assert mgr.solve("s", rng.standard_normal((2, b, 2))).ok
+        # contract slides the window but NOT the stream position of the
+        # tail: segment_offset counts every block ever streamed
+        assert mgr.contract("s", 1).ok
+        assert mgr.pivot_offset("s") == b
+        assert mgr.segment_offset("s") == 2 * b
+        st = mgr.stats()
+        assert st["failures"] == 1 and st["evicted_failures"] == 0
+
+    def test_append_before_open_fails(self):
+        rng = np.random.default_rng(53)
+        eng, mgr = _mgr()
+        with pytest.raises(KeyError, match="not open"):
+            mgr.append("ghost", *_chain(rng, 2, 4))
+        with pytest.raises(KeyError, match="not open"):
+            mgr.solve("ghost", np.zeros((2, 4, 2)))
+        # engine-level: a never-opened token is 'not open', NOT a silent
+        # fresh-start (and points at the protocol docs)
+        D, C = _chain(rng, 2, 4)
+        r = eng.solve("session_append", np.stack([D, C]),
+                      factor_token="ghost")
+        assert not r.ok and "not open" in r.error
+        assert "SERVING.md" in r.error
+
+    def test_window_shape_validation(self):
+        rng = np.random.default_rng(54)
+        eng, mgr = _mgr()
+        D, C = _chain(rng, 2, 4)
+        with pytest.raises(ValueError, match="ride"):
+            mgr.open("s", D, C[:1])
+        assert mgr.open("s", D, C).ok
+        with pytest.raises(ValueError, match="block size"):
+            mgr.append("s", *_chain(rng, 2, 8))
+        with pytest.raises(ValueError, match="nblocks"):
+            mgr.solve("s", np.zeros((3, 4, 2)))
+        with pytest.raises(ValueError, match="contract"):
+            mgr.contract("s", 2)
+
+
+# ---------------------------------------------------------------------------
+# eviction: tombstone-loud, typed raise, reseed path
+# ---------------------------------------------------------------------------
+
+
+class TestEviction:
+    def test_evicted_session_raises_and_reseeds(self):
+        rng = np.random.default_rng(60)
+        # budget fits ONE 4-block session entry (L + Wt + carry =
+        # (2·4·16 + 16) f64 elements = 1152 bytes) but not two
+        cfg = ServeConfig(
+            buckets=S_CFG.buckets, rows_buckets=S_CFG.rows_buckets,
+            nrhs_buckets=S_CFG.nrhs_buckets, max_batch=S_CFG.max_batch,
+            max_delay_s=S_CFG.max_delay_s,
+            nblocks_buckets=S_CFG.nblocks_buckets,
+            block_buckets=S_CFG.block_buckets,
+            factor_cache_bytes=2000,
+        )
+        eng, mgr = _mgr(cfg)
+        b = 4
+        D1, C1 = _chain(rng, 4, b)
+        D2, C2 = _chain(rng, 4, b)
+        assert mgr.open("s1", D1, C1).ok
+        assert mgr.open("s2", D2, C2).ok     # evicts s1 under the budget
+        B = rng.standard_normal((4, b, 2))
+        with pytest.raises(SessionEvicted, match="re-seed") as ei:
+            mgr.solve("s1", B)
+        assert ei.value.sid == "s1"
+        # the mirror is gone with the resident state
+        assert not mgr.is_open("s1")
+        with pytest.raises(KeyError):
+            mgr.solve("s1", B)
+        st = mgr.stats()
+        assert st["evicted_failures"] == 1 and st["misses"] == 1
+        assert st["hit_rate"] < 1.0
+        # re-open is the sanctioned reseed: clears the tombstone, counts
+        # as a reseed, and the session serves again
+        assert mgr.open("s1", D1, C1).ok
+        assert mgr.stats()["reseeds"] == 1
+        r = mgr.solve("s1", B)
+        assert r.ok
+        n = 4 * b
+        ref = np.linalg.solve(_np_dense(D1, np.where(
+            np.arange(4)[:, None, None] == 0, 0.0, C1)),
+            B.reshape(n, 2))
+        np.testing.assert_allclose(
+            np.float64(np.asarray(r.x)).reshape(n, 2), ref,
+            rtol=0, atol=1e-9)
+        assert ledger.validate_session_stats(mgr.stats()) == []
+
+
+# ---------------------------------------------------------------------------
+# FactorCache stats: per-entry bytes + eviction-age histogram
+# ---------------------------------------------------------------------------
+
+
+class TestFactorCacheStats:
+    def _arrays(self, n=4):
+        return (jnp.zeros((n, n), jnp.float64),)
+
+    def test_entry_bytes_ledger(self):
+        fc = FactorCache(budget_bytes=1 << 20)
+        fc.put("a", "chol", self._arrays(4), {})
+        fc.put("b", "chol", self._arrays(8), {})
+        s = fc.stats()
+        assert s["entry_bytes"] == {"a": 4 * 4 * 8, "b": 8 * 8 * 8}
+        assert s["bytes"] == sum(s["entry_bytes"].values())
+        assert s["entries"] == 2
+
+    def test_eviction_age_histogram_on_op_clock(self):
+        # ages are cache OPERATIONS, not wall time: deterministic under
+        # replay.  Entry 'a' survives 4 lookups + 1 put before eviction
+        # (age 6 -> power-of-two bucket '8'); validator cross-checks the
+        # histogram sum against the eviction counter
+        fc = FactorCache(budget_bytes=200)
+        fc.put("a", "chol", self._arrays(4), {})       # 128 bytes, clock 1
+        for _ in range(4):
+            assert fc.lookup("a") is not None          # clock 2..5
+        evicted = fc.put("b", "chol", self._arrays(4), {})  # clock 6
+        assert evicted == ["a"]
+        s = fc.stats()
+        assert s["eviction_age_hist"] == {"8": 1}
+        assert sum(s["eviction_age_hist"].values()) == s["evictions"]
+        assert fc.evicted("a")
+
+    def test_born_preserved_across_overwrite(self):
+        # overwriting a resident token refreshes arrays, NOT age: the
+        # entry's eviction age keeps counting from first install (an
+        # overwrite-heavy session would otherwise always look young)
+        fc = FactorCache(budget_bytes=1 << 20)
+        fc.put("a", "chol", self._arrays(4), {})
+        born0 = fc.peek("a").born
+        fc.lookup("a")
+        fc.put("a", "chol", self._arrays(4), {})
+        assert fc.peek("a").born == born0
+
+    @staticmethod
+    def _fc_probs(eng, fc_stats):
+        # the factor_cache block validates inside its request_stats
+        # carrier (ledger.validate_request_stats) — swap the block into
+        # a real engine snapshot and filter its problems
+        snap = eng.emit_stats()["request_stats"]
+        snap["factor_cache"] = fc_stats
+        return [p for p in ledger.validate_request_stats(snap)
+                if "factor_cache" in p]
+
+    def test_stats_block_validates_in_request_stats(self):
+        eng = SolveEngine(cfg=S_CFG)
+        fc = FactorCache(budget_bytes=200)
+        fc.put("a", "session", self._arrays(4), {})
+        fc.lookup("a")
+        fc.put("b", "session", self._arrays(4), {})
+        assert self._fc_probs(eng, fc.stats()) == []
+        # reject seams: byte ledger out of sync with the pool total,
+        # histogram out of sync with the eviction counter
+        s = fc.stats()
+        s["entry_bytes"]["b"] += 8
+        assert any("entry_bytes" in p for p in self._fc_probs(eng, s))
+        s = fc.stats()
+        s["eviction_age_hist"]["8"] = (
+            s["eviction_age_hist"].get("8", 0) + 1)
+        assert any("eviction_age_hist" in p
+                   for p in self._fc_probs(eng, s))
+
+
+# ---------------------------------------------------------------------------
+# ledger seam: serve:session_stats accept/reject + serve-report gates
+# ---------------------------------------------------------------------------
+
+
+def _session_stats(**over):
+    s = {"schema_version": 1, "opens": 2, "reseeds": 0, "appends": 3,
+         "solves": 4, "contracts": 2, "closes": 1, "failures": 0,
+         "evicted_failures": 0, "hits": 9, "misses": 0, "hit_rate": 1.0,
+         "sessions_open": 1, "sessions_known": 2, "blocks_appended": 10,
+         "blocks_dropped": 4}
+    s.update(over)
+    return s
+
+
+class TestSessionLedger:
+    def test_valid_block_accepts_and_diffs(self):
+        assert ledger.validate_session_stats(_session_stats()) == []
+        rec = ledger.record("serve:session_stats", ledger.manifest(),
+                            session_stats=_session_stats())
+        assert ledger.diff([rec], [rec]) == []
+
+    @pytest.mark.parametrize("over,needle", [
+        ({"hit_rate": 1.5}, "hit_rate"),
+        ({"hits": -1}, "hits"),
+        ({"misses": 2}, "misses"),                 # != evicted_failures
+        ({"reseeds": 3}, "reseeds"),               # > opens
+        ({"sessions_open": 5}, "sessions_open"),   # > sessions_known
+        ({"blocks_dropped": 99}, "blocks_dropped"),
+        ({"schema_version": 0}, "schema"),
+        ({"opens": "two"}, "opens"),
+    ])
+    def test_reject_seams(self, over, needle):
+        probs = ledger.validate_session_stats(_session_stats(**over))
+        assert any(needle in p for p in probs), probs
+
+    def test_malformed_record_is_incompatible(self):
+        rec = ledger.record("serve:session_stats", ledger.manifest(),
+                            session_stats=_session_stats(hit_rate=2.0))
+        with pytest.raises(ledger.LedgerIncompatible,
+                           match="session_stats"):
+            ledger.diff([rec], [rec])
+
+
+class TestServeReportGates:
+    def _write(self, path, stats):
+        ledger.append(str(path), ledger.record(
+            "serve:session_stats", ledger.manifest(),
+            session_stats=stats))
+
+    def test_gates_pass_and_fail(self, tmp_path, capsys):
+        good = tmp_path / "good.jsonl"
+        self._write(good, _session_stats())
+        assert obs_main.main([
+            "serve-report", str(good),
+            "--min-session-hit-rate", "0.85", "--max-reseeds", "0"]) == 0
+        assert "session[0]" in capsys.readouterr().out
+        # a cold ledger: 2 of 6 resident requests lost their factor
+        bad = tmp_path / "bad.jsonl"
+        self._write(bad, _session_stats(
+            reseeds=2, hits=4, misses=2, evicted_failures=2,
+            hit_rate=4 / 6))
+        assert obs_main.main([
+            "serve-report", str(bad),
+            "--min-session-hit-rate", "0.85"]) == 1
+        assert "session hit_rate" in capsys.readouterr().err
+        assert obs_main.main([
+            "serve-report", str(bad), "--max-reseeds", "1"]) == 1
+        assert "reseed" in capsys.readouterr().err
+        assert obs_main.main([
+            "serve-report", str(bad), "--max-reseeds", "2"]) == 0
+
+    def test_malformed_record_exits_2(self, tmp_path):
+        path = tmp_path / "mal.jsonl"
+        self._write(path, _session_stats(hit_rate=2.0))
+        assert obs_main.main(["serve-report", str(path)]) == 2
+
+    def test_dead_gate_fails_loudly(self, tmp_path, capsys):
+        # gates requested against a ledger with serve records but NO
+        # session_stats block: a gate nothing exercised must fail
+        eng = SolveEngine(cfg=S_CFG)
+        path = tmp_path / "nosession.jsonl"
+        eng.emit_stats(str(path))
+        assert obs_main.main([
+            "serve-report", str(path),
+            "--min-session-hit-rate", "0.85"]) == 1
+        assert "no record carries a session_stats block" in (
+            capsys.readouterr().err)
+        # and the all-gates-no-records posture still holds
+        empty = tmp_path / "empty.jsonl"
+        empty.write_text("")
+        assert obs_main.main([
+            "serve-report", str(empty), "--max-reseeds", "0"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# session-sticky routing: affinity signature + rendezvous remap locality
+# ---------------------------------------------------------------------------
+
+
+class TestAffinityRouting:
+    LADDERS = {"buckets": (8,), "rows_buckets": (32,),
+               "nrhs_buckets": (2,), "nblocks_buckets": (2, 4),
+               "block_buckets": (4,)}
+
+    def test_affinity_token_dominates_signature(self):
+        # every request of one session lands on one replica regardless
+        # of op or shape — the resident factor lives on exactly one
+        # engine, so shape-class affinity would scatter the session
+        s1 = router_mod.bucket_signature(
+            "session_solve", (2, 4, 4, 4), (4, 4, 2), "float64",
+            self.LADDERS, affinity="sess-1")
+        s2 = router_mod.bucket_signature(
+            "session_append", (2, 2, 4, 4), None, "float64",
+            self.LADDERS, tier="guaranteed", affinity="sess-1")
+        assert s1 == s2 == ("affinity", "sess-1")
+        s3 = router_mod.bucket_signature(
+            "session_solve", (2, 4, 4, 4), (4, 4, 2), "float64",
+            self.LADDERS, affinity="sess-2")
+        assert s3 != s1
+        # without affinity the signature is the shape class, as before
+        s4 = router_mod.bucket_signature(
+            "session_solve", (2, 4, 4, 4), (4, 4, 2), "float64",
+            self.LADDERS)
+        assert s4[0] != "affinity"
+
+    def test_dead_replica_remaps_only_its_own_sessions(self):
+        # the rendezvous (HRW) property the session protocol leans on:
+        # killing one replica moves ONLY the sessions it owned — every
+        # other session keeps its replica, so its resident factor (and
+        # zero-recompile steady state) survives fleet membership churn
+        replicas = ["r0", "r1", "r2"]
+        sigs = {
+            sid: router_mod.bucket_signature(
+                "session_solve", (2, 4, 4, 4), (4, 4, 2), "float64",
+                self.LADDERS, affinity=sid)
+            for sid in (f"sess-{i}" for i in range(64))
+        }
+        before = {sid: router_mod._rendezvous(sig, replicas)
+                  for sid, sig in sigs.items()}
+        # sha1 spreads 64 sessions across all three replicas
+        assert set(before.values()) == set(replicas)
+        dead = "r1"
+        alive = [r for r in replicas if r != dead]
+        after = {sid: router_mod._rendezvous(sig, alive)
+                 for sid, sig in sigs.items()}
+        for sid in sigs:
+            if before[sid] == dead:
+                assert after[sid] in alive
+            else:
+                assert after[sid] == before[sid], sid

@@ -7,8 +7,8 @@ PY ?= python
 
 .PHONY: all test benchmarking bench-explicit bench-small bench-blocktri \
 	bench-blocktri-par bench-arrowhead bench-update bench-refine \
-	bench-session tune audit lint robust serve-smoke serve-bench \
-	serve-replicas serve-trace native clean
+	bench-session tune audit lint lint-concurrency robust serve-smoke \
+	serve-bench serve-replicas serve-trace native clean
 
 all: test
 
@@ -203,8 +203,19 @@ lint:
 		--ledger lint_report.jsonl
 	$(PY) -m capital_tpu.lint source capital_tpu \
 		--fail-on warn --ledger lint_report.jsonl
+	$(PY) -m capital_tpu.lint concurrency --schedules 200 \
+		--ledger lint_report.jsonl
 	$(PY) -m capital_tpu.obs lint-report lint_report.jsonl \
-		--require-pass program --require-pass source
+		--require-pass program --require-pass source \
+		--require-pass concurrency
+
+# concurrency sanitizer alone (docs/STATIC_ANALYSIS.md "Concurrency
+# sanitizer"): the guarded-by/lock-order static pass over the serve host
+# plane plus the seeded interleaving explorer (>= 4 scenarios x 200
+# schedules, every lint/invariants.py identity checked after every step)
+# and the seeded-fault self-check that proves the gate is alive
+lint-concurrency:
+	$(PY) -m capital_tpu.lint concurrency --schedules 200
 
 # serving self-check (docs/SERVING.md): mixed-bucket CPU workload through
 # the SolveEngine, one serve:request_stats ledger record, gated on 100%

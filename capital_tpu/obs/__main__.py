@@ -928,7 +928,7 @@ def build_parser() -> argparse.ArgumentParser:
     lr.add_argument("--require-pass", action="append", default=None,
                     metavar="PASS",
                     help="fail unless a record for this pass exists "
-                         "(repeatable: program, source)")
+                         "(repeatable: program, source, concurrency)")
     lr.set_defaults(fn=_lint_report)
 
     tr = sub.add_parser(

@@ -501,7 +501,7 @@ def validate_request_stats(block) -> list[str]:
 #: treatment as request_stats: structurally validated on every diff, never
 #: metric-compared — a lint outcome is a property of the *source tree*, not
 #: of a kernel's speed, and its gate lives in ``obs lint-report``.
-_LINT_PASSES = ("program", "source")
+_LINT_PASSES = ("program", "source", "concurrency")
 _LINT_FAIL_ON = ("warn", "error")
 _LINT_COUNT_KEYS = ("error", "warn", "info")
 _LINT_FINDING_KEYS = ("rule", "severity", "target", "message", "fingerprint")

@@ -110,14 +110,14 @@ class RequestTrace:
 
     def __init__(self, request_id: int, op: str, t_enq: float, *,
                  deadline_ms: Optional[float] = None, **tags):
-        self.request_id = request_id
-        self.op = op
-        self.kind = "batched"  # rewritten by the single/failed routes
-        self.t_enq = t_enq
-        self.deadline_ms = deadline_ms
+        self.request_id = request_id  # guarded-by: <frozen>
+        self.op = op  # guarded-by: <frozen>
+        self.kind = "batched"  # guarded-by: <owner-thread>  (rewritten by the single/failed routes)
+        self.t_enq = t_enq  # guarded-by: <frozen>
+        self.deadline_ms = deadline_ms  # guarded-by: <frozen>
         # bucket / tier / replica_id / cfg_hash ride here (str or None)
-        self.tags = {k: v for k, v in tags.items() if v is not None}
-        self.spans: list[Span] = []
+        self.tags = {k: v for k, v in tags.items() if v is not None}  # guarded-by: <owner-thread>
+        self.spans: list[Span] = []  # guarded-by: <owner-thread>
 
     # ---- stamping ----------------------------------------------------------
 
@@ -318,9 +318,12 @@ class TraceLog:
     def __init__(self, cap: int = DEFAULT_TRACE_CAP):
         if cap < 1:
             raise ValueError(f"trace cap must be >= 1, got {cap}")
-        self.cap = cap
-        self.total = 0
-        self._traces: deque = deque(maxlen=cap)
+        # single-owner by default; the Router shares ONE TraceLog between
+        # its pump thread and client threads and guards every call with
+        # its RLock (see serve/router.py emit_trace)
+        self.cap = cap  # guarded-by: <frozen>
+        self.total = 0  # guarded-by: <owner-thread>
+        self._traces: deque = deque(maxlen=cap)  # guarded-by: <owner-thread>
 
     def start(self, request_id: int, op: str, t_enq: float, *,
               deadline_ms: Optional[float] = None, **tags) -> RequestTrace:

@@ -202,33 +202,33 @@ class SolveEngine:
         if cfg.max_inflight < 1:
             raise ValueError(f"max_inflight must be >= 1, got "
                              f"{cfg.max_inflight}")
-        self.grid = grid or Grid.square(c=1, devices=jax.devices()[:1])
-        self.cfg = cfg
+        self.grid = grid or Grid.square(c=1, devices=jax.devices()[:1])  # guarded-by: <frozen>
+        self.cfg = cfg  # guarded-by: <frozen>
         # validate: run the lint donation-honored rule on every executable at
         # cache-insert time — a declared donate_argnums that XLA silently
         # drops (shape mismatch with every output) raises instead of leaving
         # the batch buffer double-resident for the cache entry's lifetime.
-        self.validate = validate
-        self.stats = stats.Collector()
-        self.cache = ExecutableCache(cfg.persist_dir)
+        self.validate = validate  # guarded-by: <frozen>
+        self.stats = stats.Collector()  # guarded-by: <owner-thread>
+        self.cache = ExecutableCache(cfg.persist_dir)  # guarded-by: <owner-thread>
         # host-side resident-factor pool (serve/factorcache.py): never part
         # of a traced program, so residency changes never recompile
-        self.factors = FactorCache(cfg.factor_cache_bytes)
-        self.executor = Executor(cfg, self.grid, self.stats)
-        self.scheduler = Scheduler(cfg, self.executor, self._resolve_bucket)
+        self.factors = FactorCache(cfg.factor_cache_bytes)  # guarded-by: <owner-thread>
+        self.executor = Executor(cfg, self.grid, self.stats)  # guarded-by: <owner-thread>
+        self.scheduler = Scheduler(cfg, self.executor, self._resolve_bucket)  # guarded-by: <owner-thread>
         # per-request span traces (obs/spans.py): every submit() starts a
         # RequestTrace; the serve path stamps it host-side as the request
         # moves.  Bounded (oldest dropped, counted) — emit_trace() exports
         # the run's chains as one serve:trace record.
-        self.trace_log = spans.TraceLog()
+        self.trace_log = spans.TraceLog()  # guarded-by: <owner-thread>
         # rolling-window live telemetry (serve/telemetry.py): None until
         # enable_telemetry() attaches an aggregator to the stats tap.
-        self.telemetry = None
-        self._next_id = 0
+        self.telemetry = None  # guarded-by: <owner-thread>
+        self._next_id = 0  # guarded-by: <owner-thread>
         # the device batched executables run on — staging target.  The
         # bucket programs are single-device (jit, no sharding); oversize
         # requests run the models/ schedules on the full grid.
-        self._stage_device = self.grid.mesh.devices.ravel()[0]
+        self._stage_device = self.grid.mesh.devices.ravel()[0]  # guarded-by: <frozen>
         # config-hash: everything that changes the compiled programs or the
         # padding geometry — two engines differing here must never share
         # cache entries, and the key makes that structural.  scheduler /
@@ -240,8 +240,8 @@ class SolveEngine:
                       cfg.max_batch, cfg.precision, cfg.robust,
                       cfg.small_n_impl, cfg.tail_fuse_depth,
                       cfg.blocktri_impl, cfg.blocktri_partitions))
-        self._cfg_hash = hashlib.sha1(ident.encode()).hexdigest()[:12]
-        self._grid_key = (self.grid.dx, self.grid.dy, self.grid.c,
+        self._cfg_hash = hashlib.sha1(ident.encode()).hexdigest()[:12]  # guarded-by: <frozen>
+        self._grid_key = (self.grid.dx, self.grid.dy, self.grid.c,  # guarded-by: <frozen>
                           self.grid.platform)
 
     # ---- cache -------------------------------------------------------------
@@ -1169,6 +1169,20 @@ class SolveEngine:
             L, Wt = x[0], x[1]
             dropped = 0
             ent = self.factors.peek(token)
+            if ent is None and op != "session_open" \
+                    and self.factors.evicted(token):
+                # the resident chain was evicted between dispatch and
+                # landing (the pool honored its byte budget mid-flight).
+                # Installing only the new suffix would silently re-seed a
+                # TRUNCATED chain — every later solve against it would be
+                # wrong.  Fail loudly; "SessionEvicted:" is the tombstone
+                # contract SessionManager._lose converts to the typed
+                # SessionEvicted (misses == evicted_failures stays exact).
+                return x, raw_info, (
+                    f"SessionEvicted: resident chain {token!r} was evicted "
+                    f"mid-flight (before this {op} landed); the suffix was "
+                    "NOT installed — reopen the session and replay"
+                )
             if ent is not None and ent.kind == "session":
                 L = jnp.concatenate([ent.arrays[0], L], axis=0)
                 Wt = jnp.concatenate([ent.arrays[1], Wt], axis=0)
@@ -1277,6 +1291,18 @@ class SolveEngine:
                 return x, raw_info, None
             L, Wt = x[0], x[1]
             ent = self.factors.peek(token)
+            if ent is None and prior > 0 and self.factors.evicted(token):
+                # the resident prefix was evicted between dispatch and
+                # landing; installing only this suffix would re-seed a
+                # chain missing its first `prior` blocks — silently wrong
+                # for every later blocktri_solve.  Fail the extend loudly
+                # (the tombstone stays, so retries fail too until the
+                # client re-factors from scratch).
+                return x, raw_info, (
+                    f"resident blocktri chain {token!r} was evicted "
+                    "mid-flight (before this extend landed); the suffix "
+                    "was NOT installed — re-factor the full chain"
+                )
             if ent is not None and ent.kind == "blocktri":
                 L = jnp.concatenate([ent.arrays[0], L], axis=0)
                 Wt = jnp.concatenate([ent.arrays[1], Wt], axis=0)

@@ -76,24 +76,24 @@ class FactorCache:
         if budget_bytes <= 0:
             raise ValueError(
                 f"factor cache budget must be positive, got {budget_bytes}")
-        self.budget_bytes = int(budget_bytes)
-        self._entries: "OrderedDict[str, FactorEntry]" = OrderedDict()
-        self._tombstones: set[str] = set()
-        self.hits = 0
-        self.misses = 0
-        self.evictions = 0
-        self.installs = 0
-        self.released = 0
-        self.downdate_degrades = 0
+        self.budget_bytes = int(budget_bytes)  # guarded-by: <frozen>
+        self._entries: "OrderedDict[str, FactorEntry]" = OrderedDict()  # guarded-by: <owner-thread>
+        self._tombstones: set[str] = set()  # guarded-by: <owner-thread>
+        self.hits = 0  # guarded-by: <owner-thread>
+        self.misses = 0  # guarded-by: <owner-thread>
+        self.evictions = 0  # guarded-by: <owner-thread>
+        self.installs = 0  # guarded-by: <owner-thread>
+        self.released = 0  # guarded-by: <owner-thread>
+        self.downdate_degrades = 0  # guarded-by: <owner-thread>
         # deterministic operation clock (ticks on lookup/put): eviction
         # ages are measured on it so the age histogram is reproducible
         # under test and load replay — wall clocks are not
-        self._op_clock = 0
+        self._op_clock = 0  # guarded-by: <owner-thread>
         # eviction-age histogram: key = smallest power-of-two upper bound
         # on the evicted entry's age in cache operations (stringified for
         # JSON), value = count.  Young evictions (small keys) mean the
         # budget is thrashing; old ones mean honest retirement.
-        self._evict_age_hist: dict[str, int] = {}
+        self._evict_age_hist: dict[str, int] = {}  # guarded-by: <owner-thread>
 
     # ---- residency ---------------------------------------------------------
 

@@ -82,18 +82,21 @@ class RouterTicket:
                  tier: str = "balanced",
                  deadline_ms: Optional[float] = None,
                  affinity: Optional[str] = None):
-        self.request_id = request_id
-        self.op = op
-        self.A = A
-        self.B = B
-        self.tier = tier
-        self.deadline_ms = deadline_ms
-        self.affinity = affinity
-        self.t_enq = time.monotonic()
-        self.replica_id: Optional[str] = None  # current owner
-        self.attempts = 0
-        self.response: Optional[Result] = None
-        self._event = threading.Event()
+        self.request_id = request_id       # guarded-by: <frozen>
+        self.op = op                       # guarded-by: <frozen>
+        self.A = A                         # guarded-by: <frozen>
+        self.B = B                         # guarded-by: <frozen>
+        self.tier = tier                   # guarded-by: <frozen>
+        self.deadline_ms = deadline_ms     # guarded-by: <frozen>
+        self.affinity = affinity           # guarded-by: <frozen>
+        self.t_enq = time.monotonic()      # guarded-by: <frozen>
+        # current owner; mutated only by the Router under ITS lock
+        self.replica_id: Optional[str] = None  # guarded-by: <router-lock>
+        self.attempts = 0                  # guarded-by: <router-lock>
+        # written once (under the router lock) BEFORE _event.set(); the
+        # client's read in result() is ordered by the event wait
+        self.response: Optional[Result] = None  # guarded-by: <published-by: self._event>
+        self._event = threading.Event()    # guarded-by: <self-sync>
 
     @property
     def done(self) -> bool:
@@ -118,16 +121,16 @@ class _ReplicaState:
                  "ping_sent_at", "last_pong")
 
     def __init__(self, replica: EngineReplica):
-        self.replica = replica
-        self.outstanding: dict[int, RouterTicket] = {}
-        self.draining = False
-        self.dead = False
-        self.dispatched = 0
-        self.completed = 0
-        self.consecutive_failures = 0
-        self.ping_pending: Optional[int] = None
-        self.ping_sent_at = 0.0
-        self.last_pong = time.monotonic()
+        self.replica = replica                   # guarded-by: <frozen>
+        self.outstanding: dict[int, RouterTicket] = {}  # guarded-by: <router-lock>
+        self.draining = False                    # guarded-by: <router-lock>
+        self.dead = False                        # guarded-by: <router-lock>
+        self.dispatched = 0                      # guarded-by: <router-lock>
+        self.completed = 0                       # guarded-by: <router-lock>
+        self.consecutive_failures = 0            # guarded-by: <router-lock>
+        self.ping_pending: Optional[int] = None  # guarded-by: <router-lock>
+        self.ping_sent_at = 0.0                  # guarded-by: <router-lock>
+        self.last_pong = time.monotonic()        # guarded-by: <router-lock>
 
 
 def _rung(ladder, v: int) -> Optional[int]:
@@ -197,27 +200,29 @@ class Router:
                 f"unknown dispatch policy {cfg.policy!r}: expected one of "
                 f"{POLICIES}"
             )
-        self.cfg = cfg
-        self._lock = threading.RLock()
-        self._states: dict[str, _ReplicaState] = {}
-        self._tickets: dict[int, RouterTicket] = {}
-        self._parked: list[RouterTicket] = []
-        self._next_id = 0
-        self._ladders: Optional[dict] = None
-        self._pump_thread: Optional[threading.Thread] = None
-        self._pump_stop = threading.Event()
+        self.cfg = cfg                           # guarded-by: <frozen>
+        self._lock = threading.RLock()           # guarded-by: <lock>
+        self._states: dict[str, _ReplicaState] = {}  # guarded-by: self._lock
+        self._tickets: dict[int, RouterTicket] = {}  # guarded-by: self._lock
+        self._parked: list[RouterTicket] = []    # guarded-by: self._lock
+        self._next_id = 0                        # guarded-by: self._lock
+        self._ladders: Optional[dict] = None     # guarded-by: self._lock
+        self._pump_thread: Optional[threading.Thread] = None  # guarded-by: self._lock
+        self._pump_stop = threading.Event()      # guarded-by: <self-sync>
         # counters (docs/SERVING.md): completed counts first results only —
         # completed + len(parked) + sum(outstanding) always equals
         # dispatched-distinct, which is the no-drop invariant the tests pin
-        self.dispatched = 0  # distinct requests handed to a replica
-        self.completed = 0
-        self.redispatched = 0  # re-sends after a replica failure
-        self.duplicates = 0  # crash-race second results, dropped
-        self.failed_replicas = 0
+        # (lint/invariants.py router-no-drop states it formally)
+        self.dispatched = 0    # guarded-by: self._lock (distinct requests)
+        self.completed = 0     # guarded-by: self._lock
+        self.redispatched = 0  # guarded-by: self._lock (post-failure re-sends)
+        self.duplicates = 0    # guarded-by: self._lock (crash-race seconds)
+        self.failed_replicas = 0  # guarded-by: self._lock
         # exported span chains from every landed Result (spans.py is pure
         # Python — no jax enters this host-only module); emit_stats adds a
-        # serve:trace record when any rode back
-        self.trace_log = spans.TraceLog()
+        # serve:trace record when any rode back.  The pump thread add()s
+        # under the lock, so emit_trace must take it too.
+        self.trace_log = spans.TraceLog()        # guarded-by: self._lock
 
     # ---- membership --------------------------------------------------------
 
@@ -312,20 +317,25 @@ class Router:
                 self._flush_parked()
                 live = [st for st in self._states.values() if not st.dead]
                 for st in live:
-                    st.replica.drain(timeout=max(0.1, deadline
-                                                 - time.monotonic()))
+                    # deliberate roundtrip under the lock: a concurrent
+                    # pump() polling the same outbox would steal the ack
+                    st.replica.drain(  # lint: allow-blocking-under-lock
+                        timeout=max(0.1, deadline - time.monotonic()))
                 self.pump()
                 if not self._parked and not any(
                     st.outstanding for st in self._states.values()
                     if not st.dead
                 ):
                     return
+                # snapshot under the lock: the timeout report below runs
+                # outside it, and unlocked len() reads would race the pump
+                parked = len(self._parked)
+                outstanding = sum(len(st.outstanding)
+                                  for st in self._states.values())
             if time.monotonic() > deadline:
                 raise TimeoutError(
                     f"router drain incomplete after {timeout}s: "
-                    f"{len(self._parked)} parked, "
-                    f"{sum(len(st.outstanding) for st in self._states.values())} "
-                    "outstanding"
+                    f"{parked} parked, {outstanding} outstanding"
                 )
             time.sleep(1e-3)
 
@@ -340,7 +350,7 @@ class Router:
             st.draining = True
             # hold the lock across the sync roundtrip: a concurrent pump()
             # polling the same outbox would steal the "drained" ack
-            ok = st.replica.drain(timeout)
+            ok = st.replica.drain(timeout)  # lint: allow-blocking-under-lock
             self.pump()
             return ok
 
@@ -356,7 +366,8 @@ class Router:
         self.drain_replica(replica_id, timeout)
         with self._lock:
             st = self._states[replica_id]
-            st.replica.stop(timeout)
+            # sync stop ack under the lock, same reason as drain_replica
+            st.replica.stop(timeout)  # lint: allow-blocking-under-lock
             self._sweep_and_redispatch(st)
             st.dead = True
 
@@ -385,10 +396,11 @@ class Router:
 
     def stop(self, timeout: float = 60.0) -> None:
         """Stop pumping and gracefully stop every live replica."""
-        if self._pump_thread is not None:
+        with self._lock:
+            t, self._pump_thread = self._pump_thread, None
+        if t is not None:
             self._pump_stop.set()
-            self._pump_thread.join(timeout)
-            self._pump_thread = None
+            t.join(timeout)  # outside the lock: the pump loop takes it
         with self._lock:
             for rid in self.replica_ids():
                 self.stop_replica(rid, timeout)
@@ -402,7 +414,8 @@ class Router:
         out = {}
         with self._lock:  # keep pump() off the outboxes mid-roundtrip
             for rid in self.replica_ids():
-                info = self._states[rid].replica.warmup(specs, timeout)
+                info = self._states[rid].replica.warmup(  # lint: allow-blocking-under-lock
+                    specs, timeout)
                 out[rid] = info["fresh"] if info else None
         return out
 
@@ -412,7 +425,8 @@ class Router:
         out = {}
         with self._lock:  # keep pump() off the outboxes mid-roundtrip
             for rid in self.replica_ids():
-                snap = self._states[rid].replica.request_stats(timeout)
+                snap = self._states[rid].replica.request_stats(  # lint: allow-blocking-under-lock
+                    timeout)
                 if snap is not None:
                     out[rid] = snap
         return out
@@ -474,16 +488,17 @@ class Router:
         counterpart of SolveEngine.emit_trace.  Kept separate from
         emit_stats so consumers iterating its request_stats records never
         meet a foreign record kind."""
-        return self.trace_log.emit(path, config=self.cfg, **extra)
+        with self._lock:  # the pump thread add()s traces under the lock
+            return self.trace_log.emit(path, config=self.cfg, **extra)
 
     # ---- internals ---------------------------------------------------------
 
-    def _healthy(self) -> list[_ReplicaState]:
+    def _healthy(self) -> list[_ReplicaState]:  # lock-held: self._lock
         return [st for st in self._states.values()
                 if not st.dead and not st.draining
                 and st.replica.fatal is None]
 
-    def _pick(self, t: RouterTicket) -> Optional[_ReplicaState]:
+    def _pick(self, t: RouterTicket) -> Optional[_ReplicaState]:  # lock-held: self._lock
         healthy = self._healthy()
         if not healthy:
             return None
@@ -498,7 +513,7 @@ class Router:
         return min(healthy, key=lambda st: (len(st.outstanding),
                                             st.replica.replica_id))
 
-    def _dispatch(self, st: _ReplicaState, t: RouterTicket) -> None:
+    def _dispatch(self, st: _ReplicaState, t: RouterTicket) -> None:  # lock-held: self._lock
         """Hand one ticket to one replica; a transport failure fails the
         replica and re-routes (bounded by membership — each attempt
         removes the failed replica from the healthy set)."""
@@ -520,7 +535,7 @@ class Router:
             t.attempts += 1
             return
 
-    def _on_message(self, st: _ReplicaState, msg: tuple, now: float) -> int:
+    def _on_message(self, st: _ReplicaState, msg: tuple, now: float) -> int:  # lock-held: self._lock
         kind = msg[0]
         if kind == "result":
             return self._land(st, msg[1], msg[2])
@@ -533,7 +548,7 @@ class Router:
         # ("warmed"/"stats"/"drained") mean a sync caller timed out — inert
         return 0
 
-    def _land(self, st: _ReplicaState, rid: int, payload: dict) -> int:
+    def _land(self, st: _ReplicaState, rid: int, payload: dict) -> int:  # lock-held: self._lock
         st.outstanding.pop(rid, None)
         t = self._tickets.get(rid)
         if t is None or t.response is not None:
@@ -555,7 +570,7 @@ class Router:
         self.completed += 1
         return 1
 
-    def _heartbeat(self, st: _ReplicaState, now: float) -> None:
+    def _heartbeat(self, st: _ReplicaState, now: float) -> None:  # lock-held: self._lock
         if self.cfg.ping_interval_s <= 0:
             return
         if st.ping_pending is not None:
@@ -574,7 +589,7 @@ class Router:
                 return
             st.ping_sent_at = now
 
-    def _fail_replica(self, st: _ReplicaState) -> None:
+    def _fail_replica(self, st: _ReplicaState) -> None:  # lock-held: self._lock
         """Circuit open: final outbox sweep (crash-raced results still
         land), then re-dispatch everything unanswered; never drop."""
         if st.dead:
@@ -587,7 +602,7 @@ class Router:
         except OSError:
             pass
 
-    def _sweep_and_redispatch(self, st: _ReplicaState) -> None:
+    def _sweep_and_redispatch(self, st: _ReplicaState) -> None:  # lock-held: self._lock
         for msg in st.replica.poll():
             self._on_message(st, msg, time.monotonic())
         pending = [t for t in st.outstanding.values() if t.response is None]
@@ -600,7 +615,7 @@ class Router:
             else:
                 self._dispatch(nxt, t)
 
-    def _flush_parked(self) -> None:
+    def _flush_parked(self) -> None:  # lock-held: self._lock
         if not self._parked or not self._healthy():
             return
         parked, self._parked = self._parked, []

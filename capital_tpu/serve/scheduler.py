@@ -53,11 +53,11 @@ class Scheduler:
 
     def __init__(self, cfg, executor: Executor,
                  get_exe: Callable[[batching.Bucket], tuple]):
-        self.cfg = cfg
-        self.executor = executor
-        self._get_exe = get_exe
-        self._queues: dict[batching.Bucket, list[_Pending]] = {}
-        self._inflight: deque[InFlight] = deque()
+        self.cfg = cfg  # guarded-by: <frozen>
+        self.executor = executor  # guarded-by: <frozen>
+        self._get_exe = get_exe  # guarded-by: <frozen>
+        self._queues: dict[batching.Bucket, list[_Pending]] = {}  # guarded-by: <owner-thread>
+        self._inflight: deque[InFlight] = deque()  # guarded-by: <owner-thread>
 
     # ---- admission ---------------------------------------------------------
 

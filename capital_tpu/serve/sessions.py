@@ -122,21 +122,21 @@ class SessionManager:
     :class:`SessionEvicted` (see module docstring)."""
 
     def __init__(self, engine):
-        self.engine = engine
-        self._sessions: dict[str, _SessionState] = {}
-        self._known: set[str] = set()   # ever-opened ids: reseed detection
-        self.opens = 0
-        self.reseeds = 0
-        self.appends = 0
-        self.solves = 0
-        self.contracts = 0
-        self.closes = 0
-        self.failures = 0               # non-eviction failed responses
-        self.evicted_failures = 0       # SessionEvicted conversions
-        self.hits = 0                   # resident requests that found state
-        self.misses = 0                 # == evicted_failures (see hit_rate)
-        self.blocks_appended = 0        # open + append blocks, whole-run
-        self.blocks_dropped = 0         # contracted blocks, whole-run
+        self.engine = engine  # guarded-by: <frozen>
+        self._sessions: dict[str, _SessionState] = {}  # guarded-by: <owner-thread>
+        self._known: set[str] = set()  # guarded-by: <owner-thread>  (ever-opened ids: reseed detection)
+        self.opens = 0  # guarded-by: <owner-thread>
+        self.reseeds = 0  # guarded-by: <owner-thread>
+        self.appends = 0  # guarded-by: <owner-thread>
+        self.solves = 0  # guarded-by: <owner-thread>
+        self.contracts = 0  # guarded-by: <owner-thread>
+        self.closes = 0  # guarded-by: <owner-thread>
+        self.failures = 0  # guarded-by: <owner-thread>  (non-eviction failed responses)
+        self.evicted_failures = 0  # guarded-by: <owner-thread>  (SessionEvicted conversions)
+        self.hits = 0  # guarded-by: <owner-thread>  (resident requests that found state)
+        self.misses = 0  # guarded-by: <owner-thread>  (== evicted_failures; see hit_rate)
+        self.blocks_appended = 0  # guarded-by: <owner-thread>  (open + append blocks)
+        self.blocks_dropped = 0  # guarded-by: <owner-thread>  (contracted blocks)
 
     # ---- protocol ----------------------------------------------------------
 

@@ -63,19 +63,19 @@ class _Window:
                  "occupancies", "per_bucket")
 
     def __init__(self, t_start: float, sample_cap: int):
-        self.t_start = t_start
-        self.requests = 0
-        self.ok = 0
-        self.failed = 0
-        self.shed = 0
-        self.ops: Counter = Counter()
-        self.hist = [0] * (len(HIST_EDGES_MS) + 1)
-        self.samples = Reservoir(sample_cap)
-        self.queue_depth_max = 0
-        self.batches = 0
-        self.occupancies: list[float] = []
+        self.t_start = t_start  # guarded-by: <frozen>
+        self.requests = 0  # guarded-by: <owner-thread>
+        self.ok = 0  # guarded-by: <owner-thread>
+        self.failed = 0  # guarded-by: <owner-thread>
+        self.shed = 0  # guarded-by: <owner-thread>
+        self.ops: Counter = Counter()  # guarded-by: <owner-thread>
+        self.hist = [0] * (len(HIST_EDGES_MS) + 1)  # guarded-by: <owner-thread>
+        self.samples = Reservoir(sample_cap)  # guarded-by: <owner-thread>
+        self.queue_depth_max = 0  # guarded-by: <owner-thread>
+        self.batches = 0  # guarded-by: <owner-thread>
+        self.occupancies: list[float] = []  # guarded-by: <owner-thread>
         # str(bucket) -> {"requests", "shed", "batches", "occupancies"}
-        self.per_bucket: dict[str, dict] = {}
+        self.per_bucket: dict[str, dict] = {}  # guarded-by: <owner-thread>
 
     @property
     def empty(self) -> bool:
@@ -102,12 +102,12 @@ class WindowAggregator:
             raise ValueError(f"window_s must be > 0, got {window_s}")
         if sample_cap < 1:
             raise ValueError(f"sample_cap must be >= 1, got {sample_cap}")
-        self.window_s = float(window_s)
-        self.sample_cap = int(sample_cap)
-        self._clock = clock
-        self._open: Optional[_Window] = None
-        self._closed: list[dict] = []
-        self._emitted = 0  # prefix of _closed already written to a ledger
+        self.window_s = float(window_s)  # guarded-by: <frozen>
+        self.sample_cap = int(sample_cap)  # guarded-by: <frozen>
+        self._clock = clock  # guarded-by: <frozen>
+        self._open: Optional[_Window] = None  # guarded-by: <owner-thread>
+        self._closed: list[dict] = []  # guarded-by: <owner-thread>
+        self._emitted = 0  # guarded-by: <owner-thread>  (prefix of _closed already on a ledger)
 
     # ---- feeding -----------------------------------------------------------
 

@@ -1,0 +1,60 @@
+"""DELIBERATELY BROKEN concurrency fixture — the sanitizer's dead-gate.
+
+This file commits the two bug classes the concurrency sanitizer exists
+to catch, so the gate can prove it is alive on every run (`lint
+concurrency` self-checks against it; `obs serve-report`'s dead-gate
+discipline applied to the sanitizer itself):
+
+* ``RacyCounter`` annotates its counters guarded-by ``self._lock`` and
+  then increments them WITHOUT taking it — the static pass must emit
+  guarded-by errors here, and the interleaving explorer must reproduce
+  the lost update with a minimal failing schedule.
+* ``LockCycle`` acquires its two locks in both orders — the static pass
+  must report exactly one canonical lock-order cycle.
+
+DO NOT FIX THIS FILE.  A sanitizer release that stops flagging it is
+broken, not this fixture (tests/test_concurrency.py pins both halves,
+and the CLI exits non-zero with a loud ``self-check-dead`` finding).
+It lives under tests/fixtures — never imported by production code; the
+CLI and tests load it by file path.
+"""
+
+import threading
+
+
+class RacyCounter:
+    """Annotated like a disciplined class, implemented like a bug:
+    ``increment`` does an unguarded read-modify-write with an injectable
+    yield point in the window, so the explorer can interleave a second
+    thread between the read and the write and lose an update."""
+
+    def __init__(self, yield_point=None):
+        self._lock = threading.Lock()       # guarded-by: <lock>
+        self.count = 0                      # guarded-by: self._lock
+        self.increments = 0                 # guarded-by: self._lock
+        self._yield = yield_point or (lambda reason: None)  # guarded-by: <frozen>
+
+    def increment(self):
+        v = self.count                      # BUG: unguarded read
+        self._yield("between read and write")
+        self.count = v + 1                  # BUG: unguarded write
+        self.increments += 1                # BUG: unguarded read+write
+
+
+class LockCycle:
+    """Two locks, both orders: the canonical ABBA deadlock shape the
+    lock-acquisition graph must report as a cycle."""
+
+    def __init__(self):
+        self._a = threading.Lock()          # guarded-by: <lock>
+        self._b = threading.Lock()          # guarded-by: <lock>
+
+    def left(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def right(self):
+        with self._b:
+            with self._a:
+                pass

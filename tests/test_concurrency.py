@@ -1,0 +1,592 @@
+"""Concurrency-sanitizer tests: the guarded-by/lock-order static pass
+(per-rule pass/fail source fixtures), the invariant registry, the
+deterministic interleaving explorer (same seed -> same schedule -> same
+trace; minimal-trace reproduction of the seeded race), the engine's
+mid-flight-eviction loud-failure fix, the CLI exit codes (1 gate-fail /
+2 malformed), the dead-gate self-check, and the lint:report ledger
+round-trip through ``obs lint-report --require-pass concurrency``
+(docs/STATIC_ANALYSIS.md "Concurrency sanitizer")."""
+
+import importlib.util
+import json
+
+import numpy as np
+import pytest
+
+from capital_tpu.lint import __main__ as lint_main
+from capital_tpu.lint import concurrency, invariants, rules, schedule
+from capital_tpu.obs import __main__ as obs_main
+from capital_tpu.serve import ServeConfig, SolveEngine
+from capital_tpu.serve.factorcache import FactorCache
+
+FIXTURE = lint_main._fixture_path()
+
+S_CFG = ServeConfig(
+    buckets=(8,),
+    rows_buckets=(32,),
+    nrhs_buckets=(2,),
+    max_batch=2,
+    max_delay_s=10.0,
+    nblocks_buckets=(2, 4),
+    block_buckets=(4,),
+)
+
+
+def _lint(text, path="x/box.py"):
+    return concurrency.lint_concurrency_source(path, text=text)
+
+
+def _by_rule(findings, rule):
+    return [f for f in findings if f.rule == rule]
+
+
+def _load_fixture():
+    spec = importlib.util.spec_from_file_location("concurrency_fault",
+                                                  FIXTURE)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# static layer: per-rule pass/fail source fixtures
+# ---------------------------------------------------------------------------
+
+
+GOOD = """
+import threading
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()  # guarded-by: <lock>
+        self.items = []                # guarded-by: self._lock
+        self.cfg = 1                   # guarded-by: <frozen>
+        self.tally = 0                 # guarded-by: <owner-thread>
+
+    def add(self, x):
+        with self._lock:
+            self.items.append(x)
+
+    def size(self):  # lock-held: self._lock
+        return len(self.items)
+
+    def use(self):
+        with self._lock:
+            return self.size()
+
+    def bump(self):
+        self.tally += 1
+"""
+
+
+class TestGuardedBy:
+    def test_disciplined_class_is_clean(self):
+        assert _lint(GOOD) == []
+
+    def test_unguarded_read_and_write_flagged(self):
+        bad = GOOD + """
+    def racy(self):
+        self.items = []
+        return self.items
+"""
+        fs = _by_rule(_lint(bad), concurrency.GUARDED_BY)
+        assert len(fs) == 2
+        assert all(f.severity == rules.ERROR for f in fs)
+        assert any("write" in f.message for f in fs)
+        assert any("read" in f.message for f in fs)
+
+    def test_lock_held_marker_covers_access(self):
+        # size() touches items with no lexical with — the marker is the
+        # contract, and use() holds the lock at the call site: clean
+        assert _lint(GOOD) == []
+
+    def test_lock_held_call_without_lock_flagged(self):
+        bad = GOOD + """
+    def sloppy(self):
+        return self.size()
+"""
+        fs = _by_rule(_lint(bad), concurrency.LOCK_HELD_CALL)
+        assert len(fs) == 1
+        assert "size()" in fs[0].message
+
+    def test_missing_annotation_flagged_exhaustively(self):
+        bad = GOOD.replace("self.tally = 0                 "
+                           "# guarded-by: <owner-thread>",
+                           "self.tally = 0")
+        fs = _by_rule(_lint(bad), concurrency.GUARDED_BY_MISSING)
+        assert len(fs) == 1
+        assert "Box.tally" in fs[0].message
+
+    def test_grammar_unknown_guard_and_nonlock_flagged(self):
+        bad = GOOD.replace("# guarded-by: <frozen>", "# guarded-by: <bogus>")
+        bad = bad.replace("# guarded-by: self._lock",
+                          "# guarded-by: self.items")
+        fs = _by_rule(_lint(bad), concurrency.GUARDED_BY_GRAMMAR)
+        assert len(fs) == 2
+
+    def test_frozen_write_flagged_and_read_free(self):
+        bad = GOOD + """
+    def refreeze(self):
+        self.cfg = 2
+        return self.cfg
+"""
+        fs = _by_rule(_lint(bad), concurrency.GUARDED_BY_FROZEN)
+        assert len(fs) == 1
+        assert "refreeze" in fs[0].message
+
+    def test_inline_allow_unguarded_suppresses(self):
+        bad = GOOD + """
+    def racy(self):
+        return self.items  # lint: allow-unguarded — snapshot for repr
+"""
+        assert _lint(bad) == []
+
+    def test_unannotated_lockless_class_is_skipped(self):
+        assert _lint("class Plain:\n"
+                     "    def __init__(self):\n"
+                     "        self.x = 1\n") == []
+
+
+class TestBlockingAndCycles:
+    def test_blocking_under_lock_flagged(self):
+        bad = GOOD + """
+    def stall(self):
+        import time
+        with self._lock:
+            time.sleep(1.0)
+"""
+        fs = _by_rule(_lint(bad), concurrency.BLOCKING_UNDER_LOCK)
+        assert len(fs) == 1
+        assert "time.sleep" in fs[0].message
+
+    def test_blocking_suppression_marker(self):
+        ok = GOOD + """
+    def stall(self):
+        import time
+        with self._lock:
+            time.sleep(1.0)  # lint: allow-blocking-under-lock — test rig
+"""
+        assert _lint(ok) == []
+
+    def test_closure_body_not_under_enclosing_lock(self):
+        # the router-pump shape: a loop closure DEFINED under the lock
+        # but run later on its own thread must not be flagged
+        ok = GOOD + """
+    def start(self):
+        import time
+        with self._lock:
+            def loop():
+                time.sleep(1.0)
+            return loop
+"""
+        assert _lint(ok) == []
+
+    def test_lock_order_cycle_detected_once_canonically(self):
+        fs = _by_rule(concurrency.lint_concurrency_source(FIXTURE),
+                      concurrency.LOCK_ORDER_CYCLE)
+        assert len(fs) == 1
+        assert "LockCycle._a -> LockCycle._b -> LockCycle._a" \
+            in fs[0].message
+
+    def test_consistent_order_is_acyclic(self):
+        ok = """
+import threading
+
+class TwoLocks:
+    def __init__(self):
+        self._a = threading.Lock()  # guarded-by: <lock>
+        self._b = threading.Lock()  # guarded-by: <lock>
+
+    def one(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def two(self):
+        with self._a:
+            with self._b:
+                pass
+"""
+        assert _lint(ok) == []
+
+    def test_cycle_through_call_propagation(self):
+        bad = """
+import threading
+
+class CallCycle:
+    def __init__(self):
+        self._a = threading.Lock()  # guarded-by: <lock>
+        self._b = threading.Lock()  # guarded-by: <lock>
+
+    def inner_b(self):
+        with self._b:
+            pass
+
+    def inner_a(self):
+        with self._a:
+            pass
+
+    def left(self):
+        with self._a:
+            self.inner_b()
+
+    def right(self):
+        with self._b:
+            self.inner_a()
+"""
+        fs = _by_rule(_lint(bad), concurrency.LOCK_ORDER_CYCLE)
+        assert len(fs) == 1
+
+    def test_reentrant_same_lock_is_not_a_cycle(self):
+        ok = """
+import threading
+
+class Reent:
+    def __init__(self):
+        self._lock = threading.RLock()  # guarded-by: <lock>
+
+    def outer(self):
+        with self._lock:
+            self.inner()
+
+    def inner(self):
+        with self._lock:
+            pass
+"""
+        assert _by_rule(_lint(ok), concurrency.LOCK_ORDER_CYCLE) == []
+
+
+class TestRepoIsClean:
+    def test_serve_plane_has_zero_concurrency_errors(self):
+        # the satellite contract: fixes landed, not baseline entries
+        fs = [f for f in concurrency.lint_tree()
+              if f.severity == rules.ERROR]
+        assert fs == [], "\n".join(f.render() for f in fs)
+
+    def test_fixture_is_still_broken(self):
+        fs = concurrency.lint_concurrency_source(FIXTURE)
+        assert _by_rule(fs, concurrency.GUARDED_BY)
+        assert _by_rule(fs, concurrency.LOCK_ORDER_CYCLE)
+
+
+# ---------------------------------------------------------------------------
+# invariant registry
+# ---------------------------------------------------------------------------
+
+
+def _router_block(**over):
+    base = {"dispatched": 3, "completed": 2, "parked": 0, "redispatched": 0,
+            "duplicates": 0, "failed_replicas": 0,
+            "per_replica": {"r0": {"dispatched": 3, "completed": 2,
+                                   "outstanding": 1, "draining": False}}}
+    base.update(over)
+    return base
+
+
+def _window_block(**over):
+    base = {"requests": 4, "ok": 3, "failed": 1, "shed": 0,
+            "hist_ms": {"edges": [1.0], "counts": [4, 0]}, "sampled": 4,
+            "samples_capped": False}
+    base.update(over)
+    return base
+
+
+def _session_block(**over):
+    base = {"opens": 2, "reseeds": 1, "appends": 3, "solves": 2,
+            "contracts": 1, "closes": 1, "failures": 0,
+            "evicted_failures": 1, "hits": 6, "misses": 1,
+            "blocks_appended": 8, "blocks_dropped": 2}
+    base.update(over)
+    return base
+
+
+class TestInvariantRegistry:
+    def test_registry_names_subjects_and_lookup(self):
+        names = [inv.name for inv in invariants.REGISTRY]
+        assert names == ["router-no-drop", "router-counter-sanity",
+                         "cache-byte-ledger", "cache-counter-conservation",
+                         "window-coherence", "session-ledger"]
+        assert {inv.subject for inv in invariants.REGISTRY} \
+            == set(invariants.SUBJECTS)
+        assert len(invariants.by_subject(invariants.ROUTER)) == 2
+        with pytest.raises(ValueError, match="subject"):
+            invariants.Invariant("x", "nope", "d", lambda b: None)
+
+    def test_router_no_drop_pass_and_fail(self):
+        assert invariants.check({invariants.ROUTER: _router_block()}) == []
+        v = invariants.check(
+            {invariants.ROUTER: _router_block(completed=1)})
+        assert len(v) == 1 and v[0].startswith("router-no-drop:")
+
+    def test_router_counter_sanity(self):
+        v = invariants.check(
+            {invariants.ROUTER: _router_block(duplicates=-1)})
+        assert any("router-counter-sanity" in m for m in v)
+
+    def test_cache_invariants_on_the_real_cache(self):
+        blk = np.zeros((1, 8, 8), dtype=np.float32)
+        cache = FactorCache(budget_bytes=3 * blk.nbytes)
+        cache.put("a", "dense", (blk,), {})
+        cache.put("b", "dense", (blk, blk), {})
+        cache.lookup("a")
+        cache.put("c", "dense", (blk, blk), {})   # evicts under pressure
+        cache.release("b") if "b" in cache else None
+        assert invariants.check(
+            {invariants.FACTOR_CACHE: cache.stats()}) == []
+
+    def test_cache_invariants_catch_doctored_blocks(self):
+        blk = np.zeros((1, 8, 8), dtype=np.float32)
+        cache = FactorCache(budget_bytes=4 * blk.nbytes)
+        cache.put("a", "dense", (blk,), {})
+        s = dict(cache.stats())
+        s["bytes"] = s["bytes"] + 1
+        v = invariants.check({invariants.FACTOR_CACHE: s})
+        assert any("cache-byte-ledger" in m for m in v)
+        s = dict(cache.stats())
+        s["installs"] = 0
+        v = invariants.check({invariants.FACTOR_CACHE: s})
+        assert any("cache-counter-conservation" in m for m in v)
+
+    def test_window_coherence_pass_and_fail(self):
+        assert invariants.check(
+            {invariants.SERVE_WINDOW: _window_block()}) == []
+        v = invariants.check(
+            {invariants.SERVE_WINDOW: _window_block(shed=1)})
+        assert any("window-coherence" in m for m in v)
+        v = invariants.check(
+            {invariants.SERVE_WINDOW: _window_block(sampled=9)})
+        assert any("window-coherence" in m for m in v)
+
+    def test_session_ledger_pass_and_fail(self):
+        assert invariants.check(
+            {invariants.SESSIONS: _session_block()}) == []
+        v = invariants.check(
+            {invariants.SESSIONS: _session_block(misses=0)})
+        assert any("session-ledger" in m for m in v)
+        v = invariants.check(
+            {invariants.SESSIONS: _session_block(hits=5)})
+        assert any("session-ledger" in m for m in v)
+
+    def test_malformed_block_is_a_violation_not_a_pass(self):
+        v = invariants.check({invariants.ROUTER: {"completed": 1}})
+        assert v and "malformed" in v[0]
+
+    def test_absent_subject_is_skipped(self):
+        assert invariants.check({}) == []
+
+
+# ---------------------------------------------------------------------------
+# the engine fix: mid-flight eviction fails loudly, never truncates
+# ---------------------------------------------------------------------------
+
+
+class TestEvictionLoudFailure:
+    def _engine_with_tiny_cache(self):
+        eng = SolveEngine(cfg=S_CFG)
+        blk = np.zeros((1, 8, 8), dtype=np.float32)
+        eng.factors = FactorCache(budget_bytes=3 * blk.nbytes)
+        return eng, blk
+
+    def test_session_extend_sink_raises_session_evicted(self):
+        eng, blk = self._engine_with_tiny_cache()
+        eng.factors.put("tok", "session", (blk, blk), {"dropped": 0})
+        big = np.zeros((3, 8, 8), dtype=np.float32)
+        eng.factors.put("hog", "dense", (big,), {})   # evicts "tok"
+        assert eng.factors.evicted("tok")
+        sink = eng._session_extend_sink("session_append", "tok", 8)
+        x, info, err = sink((blk, blk), (), 0)
+        assert err is not None and err.startswith("SessionEvicted:")
+        assert eng.factors.peek("tok") is None        # nothing installed
+
+    def test_session_open_still_installs_fresh(self):
+        eng, blk = self._engine_with_tiny_cache()
+        sink = eng._session_extend_sink("session_open", "fresh", 8)
+        x, info, err = sink((blk, blk), (), 0)
+        assert err is None
+        assert eng.factors.peek("fresh") is not None
+
+    def test_blocktri_extend_sink_fails_loudly(self):
+        eng, blk = self._engine_with_tiny_cache()
+        eng.factors.put("chain", "blocktri", (blk, blk), {})
+        big = np.zeros((3, 8, 8), dtype=np.float32)
+        eng.factors.put("hog", "dense", (big,), {})   # evicts "chain"
+        sink = eng._extend_sink("chain", 8, prior=1)
+        x, info, err = sink((blk, blk), (), 0)
+        assert err is not None and "evicted" in err
+        assert eng.factors.peek("chain") is None
+
+
+# ---------------------------------------------------------------------------
+# interleaving explorer
+# ---------------------------------------------------------------------------
+
+
+class TestExplorer:
+    def test_same_seed_same_schedule_same_trace(self):
+        sc = schedule.SCENARIOS[0]
+        a = schedule.run_schedule(sc, seed=7)
+        b = schedule.run_schedule(sc, seed=7)
+        assert a.choices == b.choices and a.trace == b.trace
+
+    def test_different_seed_different_schedule(self):
+        sc = schedule.SCENARIOS[0]
+        assert schedule.run_schedule(sc, seed=7).choices \
+            != schedule.run_schedule(sc, seed=8).choices
+
+    def test_forced_replay_reproduces_trace(self):
+        sc = schedule.SCENARIOS[0]
+        a = schedule.run_schedule(sc, seed=7)
+        b = schedule.run_schedule(sc, seed=7, forced=a.choices)
+        assert b.trace == a.trace
+
+    @pytest.mark.parametrize("sc", schedule.SCENARIOS,
+                             ids=[s.name for s in schedule.SCENARIOS])
+    def test_scenarios_hold_every_invariant(self, sc):
+        failing, runs = schedule.explore(sc, 25, seed=0)
+        assert failing is None, (
+            f"{failing and failing.violation.messages}\n"
+            f"{failing and failing.render_trace()}")
+        assert runs == 25
+
+    def test_seeded_race_reproduced_with_minimal_trace(self):
+        sc = schedule.fault_scenario(_load_fixture())
+        first = next(res for res in
+                     (schedule.run_schedule(sc, seed=s) for s in range(50))
+                     if res.violation is not None)
+        shrunk = schedule.shrink(sc, first)
+        assert shrunk.violation is not None
+        assert shrunk.violation.kind == first.violation.kind
+        assert 0 < len(shrunk.trace) <= len(first.trace)
+        # the minimal schedule replays to the same violation
+        again = schedule.run_schedule(sc, seed=shrunk.seed,
+                                      forced=shrunk.choices)
+        assert again.violation is not None
+        assert again.violation.kind == shrunk.violation.kind
+
+    def test_deadlock_detected_with_trace(self):
+        def build(sched):
+            import threading
+            a, b = threading.Lock(), threading.Lock()
+
+            def left():
+                with a:
+                    sched.yield_point("holding a")
+                    with b:
+                        pass
+
+            def right():
+                with b:
+                    sched.yield_point("holding b")
+                    with a:
+                        pass
+
+            return schedule.ScenarioCtx(
+                threads=[("left", left), ("right", right)])
+
+        sc = schedule.Scenario("abba", "deadlock shape", build)
+        failing, _ = schedule.explore(sc, 30, seed=0)
+        assert failing is not None
+        assert failing.violation.kind == "deadlock"
+        assert failing.trace
+
+    def test_thread_exception_is_a_violation(self):
+        def build(sched):
+            def boom():
+                raise ValueError("scripted failure")
+
+            return schedule.ScenarioCtx(threads=[("boom", boom)])
+
+        res = schedule.run_schedule(
+            schedule.Scenario("boom", "raises", build), seed=0)
+        assert res.violation is not None
+        assert res.violation.kind == "thread-exception"
+        assert "scripted failure" in res.violation.messages[0]
+
+    def test_patched_primitives_are_restored(self):
+        import threading
+        before = (threading.Lock, threading.RLock, threading.Event)
+        schedule.run_schedule(schedule.SCENARIOS[2], seed=0)
+        assert (threading.Lock, threading.RLock, threading.Event) == before
+
+
+# ---------------------------------------------------------------------------
+# CLI exit codes, self-check dead-gate, ledger round-trip
+# ---------------------------------------------------------------------------
+
+
+class TestCLI:
+    def test_clean_repo_exits_zero(self):
+        assert lint_main.main(["concurrency", "--static-only",
+                               "--no-self-check", "--no-baseline"]) == 0
+
+    def test_gate_failure_exits_one(self, tmp_path):
+        assert lint_main.main(["concurrency", FIXTURE, "--static-only",
+                               "--no-self-check", "--no-baseline"]) == 1
+
+    def test_malformed_exits_two(self):
+        assert lint_main.main(["concurrency", "--schedules", "0",
+                               "--no-baseline"]) == 2
+        assert lint_main.main(["concurrency", "--dynamic-only",
+                               "--scenario", "no-such-scenario",
+                               "--no-baseline"]) == 2
+
+    def test_dynamic_scenario_filter_runs(self):
+        assert lint_main.main(["concurrency", "--dynamic-only",
+                               "--schedules", "5", "--no-self-check",
+                               "--scenario", "evict-vs-append",
+                               "--no-baseline"]) == 0
+
+    def test_self_check_passes_on_the_real_fixture(self):
+        assert lint_main.main(["concurrency", "--static-only",
+                               "--schedules", "30", "--no-baseline"]) == 0
+
+    def test_self_check_dead_gate_fires_on_a_fixed_fixture(
+            self, tmp_path, monkeypatch, capsys):
+        fixed = tmp_path / "fixed_fault.py"
+        fixed.write_text(
+            "import threading\n\n\n"
+            "class RacyCounter:\n"
+            "    def __init__(self, yield_point=None):\n"
+            "        self._lock = threading.Lock()  # guarded-by: <lock>\n"
+            "        self.count = 0  # guarded-by: self._lock\n"
+            "        self.increments = 0  # guarded-by: self._lock\n"
+            "        self._yield = yield_point or (lambda r: None)"
+            "  # guarded-by: <frozen>\n\n"
+            "    def increment(self):\n"
+            "        with self._lock:\n"
+            "            v = self.count\n"
+            "            self.count = v + 1\n"
+            "            self.increments += 1\n")
+        monkeypatch.setattr(lint_main, "_fixture_path",
+                            lambda: str(fixed))
+        rc = lint_main.main(["concurrency", "--static-only",
+                             "--schedules", "30", "--no-baseline"])
+        assert rc == 1
+        out = capsys.readouterr().out
+        assert "self-check-dead" in out
+
+    def test_ledger_round_trip_and_require_pass(self, tmp_path, capsys):
+        led = str(tmp_path / "lint_report.jsonl")
+        assert lint_main.main(["concurrency", "--dynamic-only",
+                               "--schedules", "5",
+                               "--scenario", "evict-vs-append",
+                               "--no-baseline", "--ledger", led]) == 0
+        with open(led) as f:
+            recs = [json.loads(line) for line in f]
+        assert len(recs) == 1
+        block = recs[0]["lint_report"]
+        assert block["pass"] == "concurrency" and block["ok"] is True
+        assert obs_main.main(["lint-report", led,
+                              "--require-pass", "concurrency"]) == 0
+        capsys.readouterr()
+        assert obs_main.main(["lint-report", led,
+                              "--require-pass", "source"]) == 1
+
+    def test_failing_dynamic_report_carries_minimal_trace(self, tmp_path):
+        mod = _load_fixture()
+        sc = schedule.fault_scenario(mod)
+        failing, _ = schedule.explore(sc, 50, seed=0)
+        f = schedule.violation_finding(sc, failing)
+        assert f.rule == schedule.INTERLEAVING
+        assert f.severity == rules.ERROR
+        assert "minimal schedule" in f.message and "step" in f.message
